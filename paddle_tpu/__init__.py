@@ -1,0 +1,61 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference: BillXW/Paddle @ /root/reference).
+
+Architecture (TPU-first, NOT a port):
+  * declarative Program/Block/Op graph API (`paddle_tpu.layers`) — source
+    compatible with fluid model code
+  * whole-block lowering to ONE XLA executable per train step
+    (core/executor.py), autodiff via jax.vjp (core/backward.py)
+  * ragged sequences as padded+lengths (core/lod.py), RNNs as lax.scan
+  * data/model parallel via jax.sharding Mesh + GSPMD (parallel/)
+
+Use `import paddle_tpu as fluid` for fluid-style code, or
+`import paddle_tpu.paddle_compat as paddle` for `paddle.*` dataset/batch
+helpers.
+"""
+from .core import framework
+from .core.framework import (  # noqa
+    Program, Block, Operator, Variable, Parameter, program_guard,
+    default_main_program, default_startup_program, switch_main_program,
+    name_scope, CPUPlace, CUDAPlace, TPUPlace, CUDAPinnedPlace, cpu_places,
+    cuda_places, tpu_places, is_compiled_with_cuda, get_flags, set_flags)
+from .core.executor import Executor, Scope, scope_guard, global_scope  # noqa
+from .core.backward import append_backward, gradients, calc_gradient  # noqa
+from .core import unique_name  # noqa
+from .core.lod import (LoDTensor, create_lod_tensor,  # noqa
+                       create_random_int_lodtensor)
+from .core import backward  # noqa
+from . import layers  # noqa
+from . import nets  # noqa
+from . import initializer  # noqa
+from .initializer import force_init_on_cpu, init_on_cpu  # noqa
+from . import optimizer  # noqa
+from . import regularizer  # noqa
+from . import clip  # noqa
+from .clip import set_gradient_clip  # noqa
+from . import metrics  # noqa
+from . import io  # noqa
+from . import profiler  # noqa
+from . import param_attr  # noqa
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
+from .data_feeder import DataFeeder  # noqa
+from . import reader  # noqa
+from .batch import batch  # noqa
+from .io import (save_inference_model, load_inference_model,  # noqa
+                 save_params, load_params, save_persistables,
+                 load_persistables)
+from . import compiler  # noqa
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa
+from .parallel.parallel_executor import ParallelExecutor  # noqa
+from . import transpiler  # noqa
+from .transpiler import (DistributeTranspiler,  # noqa
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory, InferenceTranspiler)
+from . import dataset  # noqa
+
+
+def memory_optimize_hint(*a, **k):
+    return None
+
+
+__version__ = '0.1.0'

@@ -1,0 +1,119 @@
+"""Gradient / error clipping.
+
+Parity: reference python/paddle/fluid/clip.py (ErrorClipByValue,
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+set_gradient_clip).
+"""
+from .core.framework import op_role_guard, OpRole
+
+__all__ = ['ErrorClipByValue', 'GradientClipByValue', 'GradientClipByNorm',
+           'GradientClipByGlobalNorm', 'set_gradient_clip',
+           'append_gradient_clip_ops']
+
+
+class BaseErrorClipAttr(object):
+    pass
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class BaseGradientClipAttr(object):
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _create_operators(self, param, grad):
+        block = grad.block
+        block.append_op(type='clip', inputs={'X': grad},
+                        outputs={'Out': grad},
+                        attrs={'min': self.min, 'max': self.max})
+        return param, grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _create_operators(self, param, grad):
+        block = grad.block
+        block.append_op(type='clip_by_norm', inputs={'X': grad},
+                        outputs={'Out': grad},
+                        attrs={'max_norm': self.clip_norm})
+        return param, grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name='default_group'):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        context.setdefault(self.group_name, []).append((param, grad, self))
+
+    @staticmethod
+    def _create_group_operators(group):
+        from .layers import nn as nn_layers
+        from .layers import tensor as tensor_layers
+        from .layers import ops as ops_layers
+        clip_norm = group[0][2].clip_norm
+        sq_sums = []
+        for p, g, _ in group:
+            sq = ops_layers.square(g)
+            sq_sums.append(nn_layers.reduce_sum(sq))
+        global_sq = tensor_layers.sums(sq_sums)
+        global_norm = ops_layers.sqrt(global_sq)
+        cn = tensor_layers.fill_constant([1], 'float32', clip_norm)
+        scale = cn / nn_layers.elementwise_max(global_norm, cn)
+        out = []
+        for p, g, _ in group:
+            g.block.append_op(type='elementwise_mul',
+                              inputs={'X': g, 'Y': scale},
+                              outputs={'Out': g}, attrs={'axis': -1})
+            out.append((p, g))
+        return out
+
+
+_clip_attr_of_program = {}
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    from .core.framework import default_main_program
+    program = program or default_main_program()
+    if param_list is None:
+        param_list = program.global_block().all_parameters()
+    param_list = [program.global_block().var(p) if isinstance(p, str) else p
+                  for p in param_list]
+    for p in param_list:
+        p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(param_grads):
+    res = []
+    context = {}
+    with op_role_guard(OpRole.Backward):
+        for p, g in param_grads:
+            clip = getattr(p, 'gradient_clip_attr', None)
+            if clip is None:
+                res.append((p, g))
+            elif isinstance(clip, GradientClipByGlobalNorm):
+                clip._process_context(context, p, g)
+            else:
+                res.append(clip._create_operators(p, g))
+        for group in context.values():
+            res.extend(
+                GradientClipByGlobalNorm._create_group_operators(group))
+    return res

@@ -1,0 +1,77 @@
+"""CompiledProgram / strategies (parity: python/paddle/fluid/compiler.py).
+
+The reference's BuildStrategy/ExecutionStrategy tune the SSA-graph executor
+(reduce strategy, num threads...).  Under whole-block XLA lowering most knobs
+are moot; `with_data_parallel` maps to a device-mesh data-parallel execution
+(parallel/parallel_executor.py).
+"""
+from .core.executor import _CompiledProgramBase
+
+__all__ = ['CompiledProgram', 'BuildStrategy', 'ExecutionStrategy']
+
+
+class BuildStrategy(object):
+    class ReduceStrategy(object):
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy(object):
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.memory_optimize = False
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True   # XLA always fuses
+        self.fuse_elewise_add_act_ops = True
+        self.num_trainers = 1
+        self.trainer_id = 0
+
+
+class ExecutionStrategy(object):
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 1
+        self.allow_op_delay = False
+
+
+class CompiledProgram(_CompiledProgramBase):
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._data_parallel = False
+        self._places = None
+        self._loss_name = None
+        self._pe = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        self._data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        self._places = places
+        return self
+
+    def with_inference_optimize(self, config):
+        return self
+
+    def _run(self, exe, feed, fetch_list, scope, return_numpy):
+        if not self._data_parallel:
+            return exe.run(self._program, feed=feed, fetch_list=fetch_list,
+                           scope=scope, return_numpy=return_numpy)
+        if self._pe is None:
+            from .parallel.parallel_executor import ParallelExecutor
+            self._pe = ParallelExecutor(
+                use_cuda=False, loss_name=self._loss_name,
+                main_program=self._program,
+                build_strategy=self._build_strategy)
+        fetch_names = [f if isinstance(f, str) else f.name
+                       for f in (fetch_list or [])]
+        return self._pe.run(fetch_names, feed=feed,
+                            return_numpy=return_numpy)

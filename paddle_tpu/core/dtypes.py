@@ -1,0 +1,46 @@
+"""Dtype normalization between fluid-style strings and numpy/jax dtypes.
+
+Parity: reference paddle/fluid/framework/data_type.{h,cc} VarType mapping.
+"""
+import numpy as np
+
+_STR2NP = {
+    'float32': np.float32,
+    'float64': np.float64,
+    'float16': np.float16,
+    'bfloat16': None,  # filled lazily from ml_dtypes via jax.numpy
+    'int64': np.int64,
+    'int32': np.int32,
+    'int16': np.int16,
+    'int8': np.int8,
+    'uint8': np.uint8,
+    'bool': np.bool_,
+}
+
+
+def _bf16():
+    import jax.numpy as jnp
+    return jnp.bfloat16
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype spec (str | np.dtype | jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        if dtype == 'bfloat16':
+            return np.dtype(_bf16())
+        if dtype not in _STR2NP:
+            raise ValueError("unsupported dtype string: %s" % dtype)
+        return np.dtype(_STR2NP[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_str(dtype):
+    d = convert_dtype(dtype)
+    name = d.name
+    return name
+
+
+def is_float(dtype):
+    return convert_dtype(dtype).kind == 'f' or dtype_str(dtype) == 'bfloat16'

@@ -1,0 +1,702 @@
+"""Graph IR: Program / Block / Operator / Variable.
+
+Capability parity with reference python/paddle/fluid/framework.py
+(Program, Block, Operator, Variable, program_guard, name_scope) — redesigned
+TPU-first: the IR is pure Python (no protobuf/C++ desc), and a Block is not
+interpreted op-by-op like the reference's C++ Executor; it is lowered in one
+piece to a single XLA computation by tracing the registered JAX impl of every
+op (see core/executor.py).  Shape inference runs `jax.eval_shape` on the op
+impls at graph-construction time with two trial batch sizes, so batch dims
+stay symbolic (-1) while feature dims are static — exactly what XLA needs.
+"""
+import contextlib
+import copy
+import numpy as np
+
+from . import unique_name
+from .dtypes import convert_dtype, dtype_str
+from . import registry
+
+__all__ = [
+    'Program', 'Block', 'Operator', 'Variable', 'Parameter', 'program_guard',
+    'default_main_program', 'default_startup_program', 'switch_main_program',
+    'switch_startup_program', 'name_scope', 'cpu_places', 'cuda_places',
+    'CPUPlace', 'CUDAPlace', 'TPUPlace', 'is_compiled_with_cuda',
+    'get_flags', 'set_flags',
+]
+
+# ---------------------------------------------------------------- places
+
+class Place(object):
+    """Device spec. On TPU-native builds every place lowers to the same XLA
+    backend; the class is kept for API parity with the reference's
+    CPUPlace/CUDAPlace (paddle/fluid/platform/place.h)."""
+
+    kind = 'tpu'
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) == type(other) and self.device_id == other.device_id
+
+
+class TPUPlace(Place):
+    kind = 'tpu'
+
+
+class CPUPlace(Place):
+    kind = 'cpu'
+
+
+class CUDAPlace(Place):
+    # kept for source compatibility; maps to the default accelerator
+    kind = 'tpu'
+
+
+class CUDAPinnedPlace(Place):
+    kind = 'cpu'
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace(0)]
+
+
+def cuda_places(device_ids=None):
+    import jax
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def tpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+_flags = {}
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _flags.get(k) for k in keys}
+
+
+def set_flags(d):
+    _flags.update(d)
+
+
+# ---------------------------------------------------------------- op role
+
+class OpRole(object):
+    Forward = 'forward'
+    Backward = 'backward'
+    Optimize = 'optimize'
+    LRSched = 'lr_sched'
+    Loss = 'loss'
+    RPC = 'rpc'
+    Dist = 'dist'
+
+
+_current_role = [OpRole.Forward]
+
+
+@contextlib.contextmanager
+def op_role_guard(role):
+    _current_role.append(role)
+    try:
+        yield
+    finally:
+        _current_role.pop()
+
+
+_name_scope_stack = ['']
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    _name_scope_stack.append(_name_scope_stack[-1] + (prefix or '') + '/')
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+# ---------------------------------------------------------------- Variable
+
+class Variable(object):
+    """A named tensor in a Block.
+
+    Parity: reference framework.py Variable / VarDesc. `shape` uses -1 for
+    the batch dimension.  `lod_level > 0` marks a ragged sequence variable;
+    TPU-native representation is dense padded data plus a companion
+    `<name>@LENGTH` int32 vector (see core/lod.py), never a CPU-side LoD.
+    """
+
+    def __init__(self,
+                 block,
+                 name=None,
+                 shape=None,
+                 dtype='float32',
+                 lod_level=0,
+                 persistable=False,
+                 stop_gradient=False,
+                 is_data=False,
+                 need_check_feed=False,
+                 type=None,
+                 initializer=None,
+                 **kwargs):
+        self.block = block
+        if name is None:
+            name = unique_name.generate('_generated_var')
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype_str(dtype) if dtype is not None else None
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.type = type or 'lod_tensor'
+        self.op = None  # producer op
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, v):
+        self._dtype = dtype_str(v)
+
+    @property
+    def np_dtype(self):
+        return convert_dtype(self._dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return "var %s : shape=%s dtype=%s lod=%d%s" % (
+            self.name, self.shape, self._dtype, self.lod_level,
+            ' persistable' if self.persistable else '')
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+    # -------- math op patch (reference layers/math_op_patch.py) --------
+    def _binary(self, other, op_type, reverse=False):
+        block = self.block
+        if isinstance(other, Variable):
+            x, y = (other, self) if reverse else (self, other)
+            out = block.create_var(dtype=self._dtype)
+            block.append_op(type=op_type,
+                           inputs={'X': x, 'Y': y},
+                           outputs={'Out': out},
+                           attrs={'axis': -1})
+            return out
+        # scalar path
+        v = float(other)
+        if op_type == 'elementwise_add':
+            return self._scale(1.0, v)
+        if op_type == 'elementwise_sub':
+            if reverse:
+                return self._scale(-1.0, v)
+            return self._scale(1.0, -v)
+        if op_type == 'elementwise_mul':
+            return self._scale(v, 0.0)
+        # div / pow / mod etc: materialize a constant
+        out = block.create_var(dtype=self._dtype)
+        const = block.create_var(dtype=self._dtype)
+        block.append_op(type='fill_constant',
+                       inputs={}, outputs={'Out': const},
+                       attrs={'shape': [1], 'value': v, 'dtype': self._dtype})
+        x, y = (const, self) if reverse else (self, const)
+        block.append_op(type=op_type, inputs={'X': x, 'Y': y},
+                       outputs={'Out': out}, attrs={'axis': -1})
+        return out
+
+    def _scale(self, scale, bias):
+        out = self.block.create_var(dtype=self._dtype)
+        self.block.append_op(type='scale', inputs={'X': self},
+                            outputs={'Out': out},
+                            attrs={'scale': float(scale), 'bias': float(bias),
+                                   'bias_after_scale': True})
+        return out
+
+    def __add__(self, o):
+        return self._binary(o, 'elementwise_add')
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, 'elementwise_sub')
+
+    def __rsub__(self, o):
+        return self._binary(o, 'elementwise_sub', reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, 'elementwise_mul')
+
+    __rmul__ = __mul__
+
+    def __div__(self, o):
+        return self._binary(o, 'elementwise_div')
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, o):
+        return self._binary(o, 'elementwise_div', reverse=True)
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, o):
+        return self._binary(o, 'elementwise_pow')
+
+    def __neg__(self):
+        return self._scale(-1.0, 0.0)
+
+    def _cmp(self, other, op_type):
+        out = self.block.create_var(dtype='bool')
+        other = other if isinstance(other, Variable) else _const_like(self, other)
+        self.block.append_op(type=op_type, inputs={'X': self, 'Y': other},
+                            outputs={'Out': out}, attrs={})
+        return out
+
+    def __lt__(self, o):
+        return self._cmp(o, 'less_than')
+
+    def __le__(self, o):
+        return self._cmp(o, 'less_equal')
+
+    def __gt__(self, o):
+        return self._cmp(o, 'greater_than')
+
+    def __ge__(self, o):
+        return self._cmp(o, 'greater_equal')
+
+    def astype(self, dtype):
+        out = self.block.create_var(dtype=dtype)
+        self.block.append_op(type='cast', inputs={'X': self},
+                            outputs={'Out': out},
+                            attrs={'in_dtype': self._dtype,
+                                   'out_dtype': dtype_str(dtype)})
+        return out
+
+
+def _const_like(var, value):
+    const = var.block.create_var(dtype=var.dtype)
+    var.block.append_op(type='fill_constant', inputs={}, outputs={'Out': const},
+                       attrs={'shape': [1], 'value': float(value),
+                              'dtype': var.dtype})
+    return const
+
+
+class Parameter(Variable):
+    """Trainable persistable variable (reference framework.py Parameter)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        self.trainable = kwargs.pop('trainable', True)
+        self.optimize_attr = kwargs.pop('optimize_attr', {'learning_rate': 1.0})
+        self.regularizer = kwargs.pop('regularizer', None)
+        self.gradient_clip_attr = kwargs.pop('gradient_clip_attr', None)
+        self.do_model_average = kwargs.pop('do_model_average', None)
+        self.is_distributed = kwargs.pop('is_distributed', False)
+        super(Parameter, self).__init__(
+            block, shape=shape, dtype=dtype, persistable=True,
+            stop_gradient=False, **kwargs)
+
+
+# ---------------------------------------------------------------- Operator
+
+class Operator(object):
+    """One node in a Block: op type + named input/output slots + attrs.
+
+    Parity: reference framework.py Operator / OpDesc.  Unlike the reference,
+    there is no per-op kernel: `type` keys into core/registry.py for a JAX
+    impl used both for build-time shape inference and whole-block lowering.
+    """
+
+    def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
+        self.block = block
+        self.type = type
+        self.attrs = dict(attrs or {})
+        self.attrs.setdefault('op_role', _current_role[-1])
+        self.inputs = {}        # slot -> list[str]
+        self.outputs = {}       # slot -> list[str]
+        self.input_is_list = {}
+        self.output_is_list = {}
+        for slot, vs in (inputs or {}).items():
+            if vs is None:
+                continue
+            self.input_is_list[slot] = isinstance(vs, (list, tuple))
+            vs = vs if isinstance(vs, (list, tuple)) else [vs]
+            self.inputs[slot] = [v.name if isinstance(v, Variable) else v
+                                 for v in vs]
+        for slot, vs in (outputs or {}).items():
+            if vs is None:
+                continue
+            self.output_is_list[slot] = isinstance(vs, (list, tuple))
+            vs = vs if isinstance(vs, (list, tuple)) else [vs]
+            self.outputs[slot] = [v.name if isinstance(v, Variable) else v
+                                  for v in vs]
+
+    def input_names(self):
+        return [n for vs in self.inputs.values() for n in vs]
+
+    def output_names(self):
+        return [n for vs in self.outputs.values() for n in vs]
+
+    def input(self, slot):
+        return self.inputs.get(slot, [])
+
+    def output(self, slot):
+        return self.outputs.get(slot, [])
+
+    def attr(self, name):
+        return self.attrs.get(name)
+
+    def _set_attr(self, name, val):
+        self.attrs[name] = val
+        self.block.program._bump()
+
+    set_attr = _set_attr
+
+    def has_attr(self, name):
+        return name in self.attrs
+
+    def to_string(self, *a, **k):
+        ins = {k: v for k, v in self.inputs.items()}
+        outs = {k: v for k, v in self.outputs.items()}
+        hidden = {'op_role'}
+        ats = {k: v for k, v in self.attrs.items() if k not in hidden}
+        return "{%s} = %s(%s) %s" % (outs, self.type, ins, ats)
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+
+# ---------------------------------------------------------------- Block
+
+_INFER_B1, _INFER_B2 = 7, 11
+
+
+class Block(object):
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars = {}
+        self.ops = []
+
+    @property
+    def parent(self):
+        return (self.program.blocks[self.parent_idx]
+                if self.parent_idx >= 0 else None)
+
+    # ------------- vars -------------
+    def var(self, name):
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError("var %s not found in block %d" % (name, self.idx))
+        return v
+
+    def has_var(self, name):
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name):
+        b = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent
+        return None
+
+    def create_var(self, name=None, **kwargs):
+        if name is None:
+            name = unique_name.generate('_generated_var')
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name=name, **kwargs)
+        self.vars[name] = v
+        self.program._bump()
+        return v
+
+    def create_parameter(self, name=None, shape=None, dtype='float32', **kw):
+        if name is None:
+            name = unique_name.generate('_param')
+        p = Parameter(self, shape=shape, dtype=dtype, name=name, **kw)
+        # parameters always live in the global (root) block, like the ref
+        root = self.program.blocks[0]
+        root.vars[name] = p
+        self.program._bump()
+        return p
+
+    def all_parameters(self):
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    def iter_parameters(self):
+        return iter(self.all_parameters())
+
+    # ------------- ops -------------
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self.program._bump()
+        for n in op.output_names():
+            ov = self._find_var_recursive(n)
+            if ov is not None:
+                ov.op = op
+        if infer_shape and registry.has_op(type):
+            self._infer_shapes(op)
+        return op
+
+    def prepend_op(self, type, inputs=None, outputs=None, attrs=None,
+                   infer_shape=True):
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        self.program._bump()
+        if infer_shape and registry.has_op(type):
+            self._infer_shapes(op)
+        return op
+
+    def _infer_shapes(self, op):
+        """Dual-batch abstract eval: run the op's JAX impl under
+        jax.eval_shape with batch placeholder 7 and again with 11; output
+        dims that differ between the two runs are batch dims (-1)."""
+        import jax
+
+        impl = registry.get_op(op.type).impl
+        results = []
+        for B in (_INFER_B1, _INFER_B2):
+            ins = {}
+            ok = True
+            for slot, names in op.inputs.items():
+                structs = []
+                for n in names:
+                    v = self._find_var_recursive(n)
+                    if v is None or v.shape is None:
+                        ok = False
+                        break
+                    shape = tuple(B if d in (-1, None) else int(d)
+                                  for d in v.shape)
+                    structs.append(
+                        jax.ShapeDtypeStruct(shape, v.np_dtype))
+                if not ok:
+                    break
+                ins[slot] = structs if op.input_is_list[slot] else structs[0]
+            if not ok:
+                return  # cannot infer (e.g. shapeless input); leave as-is
+            ctx = registry.InferCtx(op)
+            try:
+                out = jax.eval_shape(lambda kw: impl(ctx, kw, op.attrs), ins)
+            except Exception as e:
+                raise RuntimeError(
+                    "shape inference failed for op %s: %s\n%s" %
+                    (op.type, e, op.to_string()))
+            results.append(out)
+        r1, r2 = results
+        for slot, names in op.outputs.items():
+            o1 = r1.get(slot) if isinstance(r1, dict) else None
+            o2 = r2.get(slot) if isinstance(r2, dict) else None
+            if o1 is None:
+                continue
+            l1 = o1 if isinstance(o1, (list, tuple)) else [o1]
+            l2 = o2 if isinstance(o2, (list, tuple)) else [o2]
+            for n, s1, s2 in zip(names, l1, l2):
+                v = self._find_var_recursive(n)
+                if v is None:
+                    continue
+                shape = tuple(int(a) if a == b else -1
+                              for a, b in zip(s1.shape, s2.shape))
+                v.shape = shape
+                v.dtype = s1.dtype
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = ["block %d:" % self.idx]
+        for v in self.vars.values():
+            lines.append("  " + v.to_string())
+        for op in self.ops:
+            lines.append("  " + op.to_string())
+        return "\n".join(lines)
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+
+# ---------------------------------------------------------------- Program
+
+class Program(object):
+    """An ordered collection of Blocks — the full training/inference graph.
+
+    Parity: reference framework.py Program / ProgramDesc.  `_version` is a
+    mutation counter used by the Executor's lowering cache (the reference
+    recompiles its SSA graph on desc change; we re-trace/re-jit)."""
+
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self.random_seed = 0
+        self._version = 0
+        self._seed_counter = 0
+        self._is_test = False
+        # sharding annotations attached by parallel/transpiler.py
+        self._sharding = {}
+
+    def _bump(self):
+        self._version += 1
+
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def block(self, idx):
+        return self.blocks[idx]
+
+    def _create_block(self, parent_idx=None):
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        self._bump()
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+        if self.current_block_idx < 0:
+            self.current_block_idx = 0
+
+    def list_vars(self):
+        for b in self.blocks:
+            for v in b.vars.values():
+                yield v
+
+    def all_parameters(self):
+        return self.global_block().all_parameters()
+
+    @property
+    def num_blocks(self):
+        return len(self.blocks)
+
+    def clone(self, for_test=False):
+        """Deep-copy the program.  for_test=True keeps only forward ops,
+        flips is_test attrs on (dropout/batch_norm/...) ops, like the ref."""
+        p = Program()
+        p.random_seed = self.random_seed
+        p.blocks = []
+        for b in self.blocks:
+            nb = Block(p, b.idx, b.parent_idx)
+            for name, v in b.vars.items():
+                if isinstance(v, Parameter):
+                    nv = Parameter(nb, shape=v.shape, dtype=v.dtype, name=name,
+                                   trainable=v.trainable,
+                                   optimize_attr=v.optimize_attr,
+                                   regularizer=v.regularizer,
+                                   gradient_clip_attr=v.gradient_clip_attr)
+                else:
+                    nv = Variable(nb, name=name, shape=v.shape, dtype=v.dtype,
+                                  lod_level=v.lod_level,
+                                  persistable=v.persistable,
+                                  stop_gradient=v.stop_gradient,
+                                  is_data=v.is_data, type=v.type)
+                nb.vars[name] = nv
+            for op in b.ops:
+                role = op.attrs.get('op_role', OpRole.Forward)
+                if for_test and role in (OpRole.Backward, OpRole.Optimize,
+                                         OpRole.LRSched):
+                    continue
+                nattrs = copy.deepcopy(op.attrs)
+                if for_test and 'is_test' in nattrs:
+                    nattrs['is_test'] = True
+                nop = Operator(nb, op.type)
+                nop.attrs = nattrs
+                nop.inputs = {k: list(v) for k, v in op.inputs.items()}
+                nop.outputs = {k: list(v) for k, v in op.outputs.items()}
+                nop.input_is_list = dict(op.input_is_list)
+                nop.output_is_list = dict(op.output_is_list)
+                nb.ops.append(nop)
+            p.blocks.append(nb)
+        if for_test:
+            p._is_test = True
+        p._bump()
+        return p
+
+    def _prune(self, feeds, fetches):
+        """Return a clone keeping only ops needed to compute `fetches` from
+        `feeds` (reference Program._prune_with_input, used by
+        save_inference_model)."""
+        feed_names = set(v.name if isinstance(v, Variable) else v
+                        for v in feeds)
+        fetch_names = set(v.name if isinstance(v, Variable) else v
+                          for v in fetches)
+        p = self.clone(for_test=True)
+        b = p.global_block()
+        needed = set(fetch_names)
+        kept = []
+        for op in reversed(b.ops):
+            if set(op.output_names()) & needed:
+                kept.append(op)
+                for n in op.input_names():
+                    if n not in feed_names:
+                        needed.add(n)
+        b.ops = list(reversed(kept))
+        used = set(feed_names) | set(fetch_names)
+        for op in b.ops:
+            used.update(op.input_names())
+            used.update(op.output_names())
+        b.vars = {n: v for n, v in b.vars.items() if n in used}
+        p._bump()
+        return p
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        return "\n".join(b.to_string() for b in self.blocks)
+
+    __repr__ = __str__ = lambda self: self.to_string()
+
+
+# ------------------------------------------------- default program stack
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+def switch_main_program(program):
+    global _main_program
+    old = _main_program
+    _main_program = program
+    return old
+
+
+def switch_startup_program(program):
+    global _startup_program
+    old = _startup_program
+    _startup_program = program
+    return old
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    old_main = switch_main_program(main_program)
+    old_start = None
+    if startup_program is not None:
+        old_start = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(old_main)
+        if old_start is not None:
+            switch_startup_program(old_start)

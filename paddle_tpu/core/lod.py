@@ -1,0 +1,94 @@
+"""LoDTensor: variable-length sequence batches, TPU-native.
+
+Parity: reference paddle/fluid/framework/lod_tensor.{h,cc} and
+python/paddle/fluid/lod_tensor.py.  The reference stores ragged rows
+contiguously with a CPU-side level-of-detail offset table; that layout forces
+dynamic shapes, which XLA cannot tile onto the MXU.  Here a LoDTensor is a
+dense padded array `[batch, max_len, ...]` plus an int32 `lengths[batch]`
+vector.  Sequence ops (layers/sequence.py) consume (data, lengths) and use
+masks / segment ids — static shapes, fully fusable.
+
+When a LoDTensor is fed to `Executor.run`, the executor feeds `<name>` with
+the padded data and `<name>@LENGTH` with the lengths (see core/executor.py).
+"""
+import numpy as np
+
+__all__ = ['LoDTensor', 'create_lod_tensor', 'create_random_int_lodtensor',
+           'LENGTH_SUFFIX']
+
+LENGTH_SUFFIX = '@LENGTH'
+
+
+class LoDTensor(object):
+    def __init__(self, padded, lengths):
+        self.padded = np.asarray(padded)
+        self.lengths = np.asarray(lengths, dtype=np.int32)
+        assert self.padded.ndim >= 2, 'LoDTensor padded data needs [B, T, ...]'
+        assert self.lengths.shape == (self.padded.shape[0],)
+
+    @property
+    def shape(self):
+        return self.padded.shape
+
+    @property
+    def dtype(self):
+        return self.padded.dtype
+
+    def recursive_sequence_lengths(self):
+        return [self.lengths.tolist()]
+
+    def lod(self):
+        return [np.concatenate([[0], np.cumsum(self.lengths)]).tolist()]
+
+    def rows(self):
+        """Back to a python list of per-sequence arrays."""
+        return [self.padded[i, :l] for i, l in enumerate(self.lengths)]
+
+    def flatten_rows(self):
+        """Reference-style packed [sum(lens), ...] layout (for numpy-side
+        comparisons in tests)."""
+        return np.concatenate(self.rows(), axis=0) if len(self.lengths) else \
+            self.padded[:0, 0]
+
+    def __repr__(self):
+        return 'LoDTensor(shape=%s, lengths=%s)' % (
+            self.padded.shape, self.lengths.tolist())
+
+
+def create_lod_tensor(data, recursive_seq_lens=None, place=None,
+                      max_len=None):
+    """Build a LoDTensor.  `data` may be:
+    - a list of per-sequence numpy arrays / lists (ragged), or
+    - a packed [sum(lens), ...] array with recursive_seq_lens=[[l0, l1, ...]]
+      (the reference calling convention, lod_tensor.py:create_lod_tensor).
+    """
+    if isinstance(data, LoDTensor):
+        return data
+    if isinstance(data, (list, tuple)) and recursive_seq_lens is None:
+        rows = [np.asarray(r) for r in data]
+        rows = [r.reshape(len(r), -1) if r.ndim == 1 else r for r in rows]
+    else:
+        arr = np.asarray(data)
+        lens = list(recursive_seq_lens[-1])
+        offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+        assert offsets[-1] == arr.shape[0], (
+            'sum of seq lens %d != rows %d' % (offsets[-1], arr.shape[0]))
+        rows = [arr[offsets[i]:offsets[i + 1]] for i in range(len(lens))]
+    lengths = np.array([len(r) for r in rows], dtype=np.int32)
+    T = int(max_len or (lengths.max() if len(lengths) else 1))
+    T = max(T, 1)
+    feat = rows[0].shape[1:] if rows else (1,)
+    dtype = rows[0].dtype if rows else np.float32
+    padded = np.zeros((len(rows), T) + tuple(feat), dtype=dtype)
+    for i, r in enumerate(rows):
+        padded[i, :len(r)] = r
+    return LoDTensor(padded, lengths)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    lens = recursive_seq_lens[-1]
+    total = int(np.sum(lens))
+    data = np.random.randint(low, high + 1,
+                             size=[total] + list(base_shape)).astype(np.int64)
+    return create_lod_tensor(data, recursive_seq_lens, place)

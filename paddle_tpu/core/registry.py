@@ -1,0 +1,99 @@
+"""Op registry: op type -> JAX implementation.
+
+Replaces the reference's per-device OpKernel registry
+(paddle/fluid/framework/op_registry.h, op_info.cc).  Each op registers ONE
+pure-JAX impl used for (a) build-time shape inference via jax.eval_shape and
+(b) whole-block lowering to a single XLA computation.  There is no per-op
+kernel dispatch at runtime — XLA fuses across op boundaries.
+
+Impl signature::
+
+    @register('my_op')
+    def my_op(ctx, ins, attrs):
+        x = ins['X']              # array, or list of arrays for list slots
+        return {'Out': ...}
+
+`ctx.rng()` returns a fresh PRNG key (derived from the run seed and the op's
+position in the block, so every op — and every run — gets distinct streams).
+"""
+import jax
+
+_REGISTRY = {}
+
+__all__ = ['register', 'has_op', 'get_op', 'OpDef', 'InferCtx', 'ExecCtx']
+
+
+class OpDef(object):
+    def __init__(self, name, impl):
+        self.name = name
+        self.impl = impl
+
+
+def register(name):
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError('op %s already registered' % name)
+        _REGISTRY[name] = OpDef(name, fn)
+        return fn
+    return deco
+
+
+def has_op(name):
+    _ensure_ops_loaded()
+    return name in _REGISTRY
+
+
+def get_op(name):
+    _ensure_ops_loaded()
+    if name not in _REGISTRY:
+        raise KeyError('no JAX impl registered for op "%s"' % name)
+    return _REGISTRY[name]
+
+
+_ops_loaded = [False]
+
+
+def _ensure_ops_loaded():
+    # op impl modules register themselves on import; loaded lazily to avoid
+    # import cycles (framework -> registry -> ops -> framework)
+    if not _ops_loaded[0]:
+        _ops_loaded[0] = True
+        from .. import ops as _ops  # noqa: F401
+
+
+class InferCtx(object):
+    """Context used during build-time shape inference (abstract eval)."""
+
+    is_infer = True
+
+    def __init__(self, op=None):
+        self.op = op
+        self._key = jax.random.key(0)
+
+    def rng(self, n=0):
+        return jax.random.fold_in(self._key, n)
+
+
+class ExecCtx(object):
+    """Per-run context shared by all ops in one lowered block."""
+
+    is_infer = False
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+
+    def for_op(self, op_index, op):
+        return OpCtx(self, op_index, op)
+
+
+class OpCtx(object):
+    is_infer = False
+
+    def __init__(self, exec_ctx, op_index, op):
+        self._exec = exec_ctx
+        self.op_index = op_index
+        self.op = op
+
+    def rng(self, n=0):
+        return jax.random.fold_in(self._exec.base_key,
+                                  self.op_index * 1009 + n)

@@ -1,0 +1,72 @@
+"""DataFeeder — convert python/numpy minibatch rows into feed dicts.
+
+Parity: reference python/paddle/fluid/data_feeder.py.  Ragged (lod_level>0)
+slots become LoDTensors (padded + lengths, core/lod.py).
+"""
+import numpy as np
+
+from .core.framework import Variable, default_main_program
+from .core.lod import create_lod_tensor
+from .core.dtypes import convert_dtype
+
+__all__ = ['DataFeeder']
+
+
+class DataFeeder(object):
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.feed_lod_level = []
+        if program is None:
+            program = default_main_program()
+        for each_var in feed_list:
+            if isinstance(each_var, str):
+                each_var = program.global_block().var(each_var)
+            if not isinstance(each_var, Variable):
+                raise TypeError('feed_list should hold Variables')
+            self.feed_dtypes.append(each_var.dtype)
+            self.feed_names.append(each_var.name)
+            self.feed_lod_level.append(each_var.lod_level)
+            shape = each_var.shape
+            # strip batch (and time, for lod vars) dims
+            if each_var.lod_level > 0:
+                shape = shape[2:]
+            else:
+                shape = shape[1:]
+            self.feed_shapes.append(shape)
+        self.place = place
+
+    def feed(self, iterable):
+        rows = list(iterable)
+        feed = {}
+        for i, name in enumerate(self.feed_names):
+            dtype = convert_dtype(self.feed_dtypes[i])
+            shape = self.feed_shapes[i]
+            col = [row[i] for row in rows]
+            if self.feed_lod_level[i] > 0:
+                seqs = [np.asarray(c, dtype=dtype) for c in col]
+                seqs = [s.reshape(len(s), *shape) if shape else
+                        s.reshape(len(s), 1) for s in
+                        (s.reshape(-1) if s.ndim == 1 else s for s in seqs)]
+                feed[name] = create_lod_tensor([s for s in seqs])
+            else:
+                arr = np.asarray(col, dtype=dtype)
+                arr = arr.reshape((len(rows),) + tuple(
+                    int(abs(d)) for d in shape))
+                feed[name] = arr
+        return feed
+
+    def feed_parallel(self, iterable, num_places=None):
+        # one merged batch; sharding over devices happens inside pjit
+        merged = []
+        for batch in iterable:
+            merged.extend(batch)
+        return self.feed(merged)
+
+    def decorate_reader(self, reader, multi_devices=False, num_places=None,
+                        drop_last=True):
+        def _reader():
+            for batch in reader():
+                yield self.feed(batch)
+        return _reader

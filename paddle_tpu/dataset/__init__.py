@@ -1,0 +1,27 @@
+"""Datasets (parity: python/paddle/dataset/).
+
+Zero-egress environment: the reference downloads from public mirrors; here
+each dataset is a DETERMINISTIC synthetic generator with the same reader
+API, shapes, dtypes and label/vocab semantics, so every model/unit test runs
+unchanged.  Real data can be dropped into $PADDLE_TPU_DATA_HOME with the
+reference file layouts and will be picked up where implemented.
+"""
+from . import mnist  # noqa
+from . import cifar  # noqa
+from . import uci_housing  # noqa
+from . import imdb  # noqa
+from . import imikolov  # noqa
+from . import wmt14  # noqa
+from . import wmt16  # noqa
+from . import movielens  # noqa
+from . import conll05  # noqa
+from . import flowers  # noqa
+from . import sentiment  # noqa
+from . import mq2007  # noqa
+from . import voc2012  # noqa
+from . import common  # noqa
+from . import image  # noqa
+
+__all__ = ['mnist', 'cifar', 'uci_housing', 'imdb', 'imikolov', 'wmt14',
+           'wmt16', 'movielens', 'conll05', 'flowers', 'sentiment',
+           'mq2007', 'voc2012', 'common', 'image']

@@ -1,0 +1,42 @@
+"""CIFAR-10/100 (parity: python/paddle/dataset/cifar.py). Synthetic."""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+_T = {}
+
+
+def _template(num_classes, label):
+    key = (num_classes, label)
+    if key not in _T:
+        rng = np.random.RandomState(4321 + label + num_classes)
+        _T[key] = rng.uniform(0, 1, (3 * 32 * 32,)).astype('float32')
+    return _T[key]
+
+
+def _reader(split, num_classes, n):
+    def reader():
+        rng = deterministic_rng('cifar%d' % num_classes, split)
+        for i in range(n):
+            label = int(rng.randint(0, num_classes))
+            img = _template(num_classes, label) + \
+                rng.normal(0, 0.3, (3 * 32 * 32,)).astype('float32')
+            yield np.clip(img, 0, 1).astype('float32'), label
+    return reader
+
+
+def train10():
+    return _reader('train', 10, 8192)
+
+
+def test10():
+    return _reader('test', 10, 1024)
+
+
+def train100():
+    return _reader('train', 100, 8192)
+
+
+def test100():
+    return _reader('test', 100, 1024)

@@ -1,0 +1,42 @@
+"""Shared dataset plumbing (parity: python/paddle/dataset/common.py)."""
+import os
+import numpy as np
+
+__all__ = ['DATA_HOME', 'md5file', 'download', 'cluster_files_reader',
+           'deterministic_rng']
+
+DATA_HOME = os.environ.get('PADDLE_TPU_DATA_HOME',
+                           os.path.expanduser('~/.cache/paddle_tpu/dataset'))
+
+
+def md5file(fname):
+    import hashlib
+    h = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b''):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    raise RuntimeError(
+        'zero-egress environment: place files under %s/%s manually'
+        % (DATA_HOME, module_name))
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=np.load):
+    def reader():
+        import glob
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = file_list[trainer_id::trainer_count]
+        for fn in my_files:
+            for item in loader(fn):
+                yield item
+    return reader
+
+
+def deterministic_rng(name, split):
+    """Stable per-(dataset, split) RNG so synthetic data is reproducible."""
+    seed = abs(hash((name, split))) % (2 ** 31)
+    return np.random.RandomState(seed)

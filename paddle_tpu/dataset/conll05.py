@@ -1,0 +1,43 @@
+"""CoNLL-2005 SRL (parity: python/paddle/dataset/conll05.py). Synthetic."""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['get_dict', 'get_embedding', 'test']
+
+_WORD_V, _VERB_V, _LABEL_V = 44068, 3162, 59
+
+
+def get_dict():
+    word_dict = {('w%d' % i): i for i in range(_WORD_V)}
+    verb_dict = {('v%d' % i): i for i in range(_VERB_V)}
+    label_dict = {('l%d' % i): i for i in range(_LABEL_V)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = np.random.RandomState(3)
+    return rng.normal(0, 0.1, (_WORD_V, 32)).astype('float32')
+
+
+def _reader(split, n):
+    def reader():
+        rng = deterministic_rng('conll05', split)
+        for i in range(n):
+            length = int(rng.randint(5, 40))
+            word = rng.randint(0, _WORD_V, (length,)).astype('int64')
+            preds = [rng.randint(0, _WORD_V)] * length
+            marks = (rng.uniform(size=length) < 0.2).astype('int64')
+            label = ((word + marks) % _LABEL_V).astype('int64')
+            ctx = [word.tolist()] * 5
+            yield (word.tolist(), *ctx, 
+                   np.asarray(preds, dtype='int64').tolist(),
+                   marks.tolist(), label.tolist())
+    return reader
+
+
+def test():
+    return _reader('test', 512)
+
+
+def train():
+    return _reader('train', 4096)

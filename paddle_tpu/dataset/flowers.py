@@ -1,0 +1,38 @@
+"""Oxford flowers-102 (parity: python/paddle/dataset/flowers.py).
+Synthetic 3x224x224 images."""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'valid']
+
+_T = {}
+
+
+def _template(label):
+    if label not in _T:
+        rng = np.random.RandomState(777 + label)
+        _T[label] = rng.uniform(0, 1, (3, 224, 224)).astype('float32')
+    return _T[label]
+
+
+def _reader(split, n, use_xmap=True):
+    def reader():
+        rng = deterministic_rng('flowers', split)
+        for i in range(n):
+            label = int(rng.randint(0, 102))
+            img = _template(label) + \
+                rng.normal(0, 0.25, (3, 224, 224)).astype('float32')
+            yield np.clip(img, 0, 1).astype('float32').flatten(), label
+    return reader
+
+
+def train(use_xmap=True):
+    return _reader('train', 2048, use_xmap)
+
+
+def test(use_xmap=True):
+    return _reader('test', 256, use_xmap)
+
+
+def valid(use_xmap=True):
+    return _reader('valid', 256, use_xmap)

@@ -1,0 +1,53 @@
+"""Image transforms (parity: python/paddle/dataset/image.py), numpy-only."""
+import numpy as np
+
+__all__ = ['resize_short', 'to_chw', 'center_crop', 'random_crop',
+           'left_right_flip', 'simple_transform']
+
+
+def _chw_to_hwc(im):
+    return im.transpose(1, 2, 0) if im.ndim == 3 and im.shape[0] in (1, 3) \
+        else im
+
+
+def resize_short(im, size):
+    h, w = im.shape[:2]
+    scale = size / min(h, w)
+    nh, nw = int(h * scale), int(w * scale)
+    ys = (np.arange(nh) * h / nh).astype(int)
+    xs = (np.arange(nw) * w / nw).astype(int)
+    return im[ys][:, xs]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    hs, ws = (h - size) // 2, (w - size) // 2
+    return im[hs:hs + size, ws:ws + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    hs = np.random.randint(0, h - size + 1)
+    ws = np.random.randint(0, w - size + 1)
+    return im[hs:hs + size, ws:ws + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    im = resize_short(im, resize_size)
+    im = random_crop(im, crop_size) if is_train else \
+        center_crop(im, crop_size)
+    if is_train and np.random.randint(2):
+        im = left_right_flip(im)
+    im = to_chw(im).astype('float32')
+    if mean is not None:
+        im -= np.asarray(mean).reshape(-1, 1, 1)
+    return im

@@ -1,0 +1,44 @@
+"""IMDB sentiment (parity: python/paddle/dataset/imdb.py).
+
+Synthetic: two vocab halves carry positive/negative signal; sequences are
+variable-length word-id lists + 0/1 label.
+"""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'word_dict']
+
+_VOCAB = 5147  # close to the reference's cutoff vocab
+
+
+def word_dict():
+    return {('w%d' % i): i for i in range(_VOCAB)}
+
+
+def _reader(split, n, word_idx=None):
+    v = len(word_idx) if word_idx else _VOCAB
+
+    def reader():
+        rng = deterministic_rng('imdb', split)
+        for i in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 100))
+            half = v // 2
+            if label:
+                ids = rng.randint(0, half, (length,))
+            else:
+                ids = rng.randint(half, v - 1, (length,))
+            # mix in noise words
+            noise = rng.randint(0, v - 1, (length,))
+            mask = rng.uniform(size=length) < 0.25
+            ids = np.where(mask, noise, ids)
+            yield ids.astype('int64').tolist(), label
+    return reader
+
+
+def train(word_idx=None):
+    return _reader('train', 4096, word_idx)
+
+
+def test(word_idx=None):
+    return _reader('test', 512, word_idx)

@@ -1,0 +1,39 @@
+"""PTB language model n-grams (parity: python/paddle/dataset/imikolov.py).
+
+Synthetic Markov-chain text with a fixed transition structure so that a
+real LM can learn it.
+"""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'build_dict']
+
+N_WORDS = 2073  # ref vocab ~2074 with <unk>
+
+
+def build_dict(min_word_freq=50):
+    return {('w%d' % i): i for i in range(N_WORDS)}
+
+
+def _reader(split, n, word_idx, ngram):
+    v = len(word_idx)
+
+    def reader():
+        rng = deterministic_rng('imikolov', split)
+        # deterministic sparse transition: next = (3*cur + noise) % v
+        for i in range(n):
+            start = int(rng.randint(0, v))
+            seq = [start]
+            for _ in range(ngram - 1):
+                nxt = (3 * seq[-1] + int(rng.randint(0, 3))) % v
+                seq.append(nxt)
+            yield tuple(seq)
+    return reader
+
+
+def train(word_idx, n=5, data_type=1):
+    return _reader('train', 8192, word_idx, n)
+
+
+def test(word_idx, n=5, data_type=1):
+    return _reader('test', 1024, word_idx, n)

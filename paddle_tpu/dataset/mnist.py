@@ -1,0 +1,43 @@
+"""MNIST (parity: python/paddle/dataset/mnist.py).
+
+Synthetic separable digits: each class k has a fixed template image; samples
+are template + noise, so classifiers genuinely learn (loss decreases, acc
+rises) — suitable for convergence tests and benchmarks.
+"""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test']
+
+_TEMPLATES = {}
+
+
+def _template(label):
+    if label not in _TEMPLATES:
+        rng = np.random.RandomState(1234 + label)
+        t = rng.uniform(-1, 1, (784,)).astype('float32')
+        _TEMPLATES[label] = t
+    return _TEMPLATES[label]
+
+
+def _reader(split, n):
+    def reader():
+        rng = deterministic_rng('mnist', split)
+        for i in range(n):
+            label = int(rng.randint(0, 10))
+            img = _template(label) + \
+                rng.normal(0, 0.35, (784,)).astype('float32')
+            yield np.clip(img, -1, 1).astype('float32'), label
+    return reader
+
+
+def train():
+    return _reader('train', 8192)
+
+
+def test():
+    return _reader('test', 1024)
+
+
+def fetch():
+    pass

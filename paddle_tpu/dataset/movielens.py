@@ -1,0 +1,69 @@
+"""MovieLens-1M (parity: python/paddle/dataset/movielens.py).
+
+Synthetic user/movie features + rating = f(user, movie) with latent
+factors, mirroring the reference record layout:
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+ score).
+"""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
+           'age_table', 'movie_categories', 'get_movie_title_dict']
+
+_N_USERS, _N_MOVIES, _N_JOBS, _N_CATS, _TITLE_VOCAB = 6040, 3952, 21, 18, 5175
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+_ruser = np.random.RandomState(11)
+_rmovie = np.random.RandomState(12)
+_UF = _ruser.normal(0, 1, (_N_USERS + 1, 8)).astype('float32')
+_MF = _rmovie.normal(0, 1, (_N_MOVIES + 1, 8)).astype('float32')
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {('cat%d' % i): i for i in range(_N_CATS)}
+
+
+def get_movie_title_dict():
+    return {('t%d' % i): i for i in range(_TITLE_VOCAB)}
+
+
+def _reader(split, n):
+    def reader():
+        rng = deterministic_rng('movielens', split)
+        for i in range(n):
+            uid = int(rng.randint(1, _N_USERS + 1))
+            mid = int(rng.randint(1, _N_MOVIES + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, len(age_table)))
+            job = int(rng.randint(0, _N_JOBS))
+            n_cat = int(rng.randint(1, 4))
+            cats = rng.randint(0, _N_CATS, (n_cat,)).astype('int64').tolist()
+            n_tit = int(rng.randint(1, 6))
+            title = rng.randint(0, _TITLE_VOCAB,
+                                (n_tit,)).astype('int64').tolist()
+            score = float(np.clip(
+                2.5 + _UF[uid].dot(_MF[mid]) / 3.0 + rng.normal(0, 0.3),
+                1.0, 5.0))
+            yield [uid], [gender], [age], [job], [mid], cats, title, score
+    return reader
+
+
+def train():
+    return _reader('train', 8192)
+
+
+def test():
+    return _reader('test', 1024)

@@ -1,0 +1,35 @@
+"""MQ2007 learning-to-rank (parity: python/paddle/dataset/mq2007.py).
+Synthetic query groups with 46 features per doc."""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test']
+
+_W = np.random.RandomState(9).uniform(-1, 1, (46,)).astype('float32')
+
+
+def _reader(split, n, format='pairwise'):
+    def reader():
+        rng = deterministic_rng('mq2007', split)
+        for q in range(n):
+            ndocs = int(rng.randint(5, 20))
+            feats = rng.uniform(0, 1, (ndocs, 46)).astype('float32')
+            rel = (feats.dot(_W) + rng.normal(0, 0.1, ndocs))
+            labels = np.digitize(rel, np.quantile(rel, [0.5, 0.8]))
+            if format == 'listwise':
+                yield labels.astype('float32'), feats
+            else:
+                order = np.argsort(-rel)
+                for a in range(min(3, ndocs - 1)):
+                    i, j = order[a], order[-(a + 1)]
+                    if labels[i] > labels[j]:
+                        yield 1.0, feats[i], feats[j]
+    return reader
+
+
+def train(format='pairwise'):
+    return _reader('train', 512, format)
+
+
+def test(format='pairwise'):
+    return _reader('test', 64, format)

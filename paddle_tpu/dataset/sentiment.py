@@ -1,0 +1,16 @@
+"""Movie-review sentiment (parity: python/paddle/dataset/sentiment.py)."""
+from . import imdb
+
+__all__ = ['get_word_dict', 'train', 'test']
+
+
+def get_word_dict():
+    return sorted(imdb.word_dict().items(), key=lambda kv: kv[1])
+
+
+def train():
+    return imdb.train()
+
+
+def test():
+    return imdb.test()

@@ -1,0 +1,41 @@
+"""UCI housing (parity: python/paddle/dataset/uci_housing.py).
+
+Synthetic linear-regression data y = x.w + b + noise, 13 features,
+matching the reference feature count.
+"""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'feature_range']
+
+feature_names = ['CRIM', 'ZN', 'INDUS', 'CHAS', 'NOX', 'RM', 'AGE', 'DIS',
+                 'RAD', 'TAX', 'PTRATIO', 'B', 'LSTAT']
+
+_W = np.random.RandomState(7).uniform(-1, 1, (13,)).astype('float32')
+_B = 0.5
+
+
+def _reader(split, n):
+    def reader():
+        rng = deterministic_rng('uci_housing', split)
+        for i in range(n):
+            x = rng.uniform(-1, 1, (13,)).astype('float32')
+            y = float(x.dot(_W) + _B + rng.normal(0, 0.05))
+            yield x, np.array([y], dtype='float32')
+    return reader
+
+
+def train():
+    return _reader('train', 404)
+
+
+def test():
+    return _reader('test', 102)
+
+
+def feature_range(maximums, minimums):
+    pass
+
+
+def fetch():
+    pass

@@ -1,0 +1,28 @@
+"""VOC2012 segmentation (parity: python/paddle/dataset/voc2012.py).
+Synthetic image + dense label pairs."""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'val']
+
+
+def _reader(split, n):
+    def reader():
+        rng = deterministic_rng('voc2012', split)
+        for i in range(n):
+            img = rng.uniform(0, 1, (3, 64, 64)).astype('float32')
+            lbl = (img.sum(0) > 1.5).astype('int32')
+            yield img, lbl
+    return reader
+
+
+def train():
+    return _reader('train', 512)
+
+
+def test():
+    return _reader('test', 64)
+
+
+def val():
+    return _reader('val', 64)

@@ -1,0 +1,32 @@
+"""WMT14 en-fr (parity: python/paddle/dataset/wmt14.py).
+
+Synthetic translation pairs: target = deterministic per-token mapping of
+source (a learnable copy-ish task).  Yields (src_ids, trg_ids, trg_next).
+"""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test']
+
+_START, _END, _UNK = 0, 1, 2
+
+
+def _reader(split, n, dict_size):
+    def reader():
+        rng = deterministic_rng('wmt14', split)
+        for i in range(n):
+            length = int(rng.randint(4, 30))
+            src = rng.randint(3, dict_size, (length,)).astype('int64')
+            trg = ((src * 7 + 3) % (dict_size - 3) + 3).astype('int64')
+            trg_in = np.concatenate([[_START], trg])
+            trg_next = np.concatenate([trg, [_END]])
+            yield src.tolist(), trg_in.tolist(), trg_next.tolist()
+    return reader
+
+
+def train(dict_size):
+    return _reader('train', 4096, dict_size)
+
+
+def test(dict_size):
+    return _reader('test', 512, dict_size)

@@ -1,0 +1,33 @@
+"""WMT16 en-de (parity: python/paddle/dataset/wmt16.py). Synthetic."""
+import numpy as np
+from .common import deterministic_rng
+
+__all__ = ['train', 'test', 'get_dict']
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {('%s_w%d' % (lang, i)): i for i in range(dict_size)}
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _reader(split, n, src_dict_size, trg_dict_size):
+    def reader():
+        rng = deterministic_rng('wmt16', split)
+        for i in range(n):
+            length = int(rng.randint(4, 40))
+            src = rng.randint(3, src_dict_size, (length,)).astype('int64')
+            trg = ((src * 5 + 11) % (trg_dict_size - 3) + 3).astype('int64')
+            trg_in = np.concatenate([[0], trg])
+            trg_next = np.concatenate([trg, [1]])
+            yield src.tolist(), trg_in.tolist(), trg_next.tolist()
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en'):
+    return _reader('train', 4096, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en'):
+    return _reader('test', 512, src_dict_size, trg_dict_size)

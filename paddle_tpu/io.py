@@ -1,0 +1,241 @@
+"""Checkpoint / inference-model save & load.
+
+Parity: reference python/paddle/fluid/io.py (save_vars, save_params,
+save_persistables, load_*, save_inference_model, load_inference_model).
+Programs serialize to a JSON-able dict (no protobuf); tensors to .npz.
+"""
+import json
+import os
+import numpy as np
+
+from .core.framework import (Program, Variable, Parameter,
+                             default_main_program)
+from .core.executor import global_scope
+
+__all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
+           'load_params', 'load_persistables', 'save_inference_model',
+           'load_inference_model', 'program_to_desc', 'desc_to_program',
+           'save_checkpoint', 'load_checkpoint']
+
+_PARAMS_FILE = '__params__.npz'
+_PROGRAM_FILE = '__model__.json'
+
+
+def _resolve(main_program):
+    return main_program if main_program is not None else \
+        default_main_program()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = _resolve(main_program)
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    arrays = {}
+    for v in vars:
+        name = v.name if isinstance(v, Variable) else v
+        if name in scope:
+            arrays[name] = np.asarray(scope.get(name))
+    np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
+
+
+def _is_param(v):
+    return isinstance(v, Parameter)
+
+
+def _is_persistable(v):
+    return v.persistable
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, _is_param, filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, None, _is_persistable,
+              filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = _resolve(main_program)
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    path = os.path.join(dirname, filename or _PARAMS_FILE)
+    data = np.load(path, allow_pickle=False)
+    scope = global_scope()
+    names = {v.name if isinstance(v, Variable) else v for v in vars}
+    for name in data.files:
+        if name in names:
+            scope.set(name, data[name])
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, _is_param, filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, None, _is_persistable,
+              filename)
+
+
+# ------------------------------------------------- program serialization
+
+def program_to_desc(program):
+    """Serialize a Program to a JSON-able dict (replaces the reference's
+    ProgramDesc protobuf, framework/framework.proto)."""
+    blocks = []
+    for b in program.blocks:
+        vars_ = []
+        for v in b.vars.values():
+            vars_.append({
+                'name': v.name,
+                'shape': list(v.shape) if v.shape is not None else None,
+                'dtype': v.dtype,
+                'lod_level': v.lod_level,
+                'persistable': v.persistable,
+                'stop_gradient': v.stop_gradient,
+                'is_data': v.is_data,
+                'is_parameter': isinstance(v, Parameter),
+                'trainable': getattr(v, 'trainable', False),
+                'lod_length_name': getattr(v, 'lod_length_name', None),
+            })
+        ops = []
+        for op in b.ops:
+            ops.append({
+                'type': op.type,
+                'inputs': op.inputs,
+                'outputs': op.outputs,
+                'input_is_list': op.input_is_list,
+                'output_is_list': op.output_is_list,
+                'attrs': _jsonable_attrs(op.attrs),
+            })
+        blocks.append({'idx': b.idx, 'parent_idx': b.parent_idx,
+                       'vars': vars_, 'ops': ops})
+    return {'version': 1, 'random_seed': program.random_seed,
+            'blocks': blocks}
+
+
+def _jsonable_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {'__ndarray__': v.tolist(), 'dtype': str(v.dtype)}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+def desc_to_program(desc):
+    from .core.framework import Block, Operator
+    program = Program()
+    program.random_seed = desc.get('random_seed', 0)
+    program.blocks = []
+    for bd in desc['blocks']:
+        b = Block(program, bd['idx'], bd['parent_idx'])
+        for vd in bd['vars']:
+            if vd.get('is_parameter'):
+                v = Parameter(b, shape=vd['shape'], dtype=vd['dtype'],
+                              name=vd['name'], trainable=vd.get(
+                                  'trainable', True))
+            else:
+                v = Variable(b, name=vd['name'], shape=vd['shape'],
+                             dtype=vd['dtype'], lod_level=vd['lod_level'],
+                             persistable=vd['persistable'],
+                             stop_gradient=vd['stop_gradient'],
+                             is_data=vd['is_data'])
+            if vd.get('lod_length_name'):
+                v.lod_length_name = vd['lod_length_name']
+            b.vars[v.name] = v
+        for od in bd['ops']:
+            op = Operator(b, od['type'])
+            op.inputs = {k: list(v) for k, v in od['inputs'].items()}
+            op.outputs = {k: list(v) for k, v in od['outputs'].items()}
+            op.input_is_list = od['input_is_list']
+            op.output_is_list = od['output_is_list']
+            attrs = {}
+            for k, v in od['attrs'].items():
+                if isinstance(v, dict) and '__ndarray__' in v:
+                    attrs[k] = np.asarray(v['__ndarray__'],
+                                          dtype=v['dtype'])
+                else:
+                    attrs[k] = v
+            op.attrs = attrs
+            b.ops.append(op)
+        program.blocks.append(b)
+    program._bump()
+    return program
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    main_program = _resolve(main_program)
+    pruned = main_program._prune(feeded_var_names, target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    desc = program_to_desc(pruned)
+    desc['feed_names'] = list(feeded_var_names)
+    desc['fetch_names'] = [t.name if isinstance(t, Variable) else t
+                           for t in target_vars]
+    with open(os.path.join(dirname, model_filename or _PROGRAM_FILE),
+              'w') as f:
+        json.dump(desc, f)
+    save_vars(executor, dirname, pruned, None, _is_persistable,
+              params_filename)
+    return desc['fetch_names']
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, pserver_endpoints=None):
+    with open(os.path.join(dirname, model_filename or _PROGRAM_FILE)) as f:
+        desc = json.load(f)
+    program = desc_to_program(desc)
+    load_vars(executor, dirname, program, None, _is_persistable,
+              params_filename)
+    feed_names = desc['feed_names']
+    fetch_vars = [program.global_block().var(n)
+                  for n in desc['fetch_names']]
+    return program, feed_names, fetch_vars
+
+
+# ------------------------------------------------- checkpoint / resume
+
+def save_checkpoint(executor, dirname, main_program=None, step=0,
+                    max_keep=3):
+    """Step-numbered checkpoint with resume metadata (parity: reference
+    trainer.py checkpoint feature)."""
+    ckpt_dir = os.path.join(dirname, 'ckpt_%d' % step)
+    save_persistables(executor, ckpt_dir, main_program)
+    with open(os.path.join(ckpt_dir, 'META'), 'w') as f:
+        json.dump({'step': step}, f)
+    # rotate
+    kept = sorted([d for d in os.listdir(dirname)
+                   if d.startswith('ckpt_')],
+                  key=lambda d: int(d.split('_')[1]))
+    for d in kept[:-max_keep]:
+        import shutil
+        shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+
+
+def load_checkpoint(executor, dirname, main_program=None):
+    """Load the newest checkpoint; returns the step to resume from (0 if
+    none found)."""
+    if not os.path.isdir(dirname):
+        return 0
+    kept = sorted([d for d in os.listdir(dirname)
+                   if d.startswith('ckpt_')],
+                  key=lambda d: int(d.split('_')[1]))
+    if not kept:
+        return 0
+    ckpt_dir = os.path.join(dirname, kept[-1])
+    load_persistables(executor, ckpt_dir, main_program)
+    with open(os.path.join(ckpt_dir, 'META')) as f:
+        return json.load(f)['step']

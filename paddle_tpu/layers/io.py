@@ -1,0 +1,156 @@
+"""Input layers & readers.
+
+Parity: reference python/paddle/fluid/layers/io.py (data, py_reader, batch,
+shuffle, double_buffer, read_file, open_files).  TPU-native: readers are
+host-side prefetch pipelines (the device pipeline is the jitted step); a
+ragged (lod_level>0) data var is declared as padded [-1, -1, ...] plus a
+companion `<name>@LENGTH` int32 vector fed automatically from a LoDTensor.
+"""
+from ..core.framework import default_main_program, default_startup_program
+from ..core.layer_helper import LayerHelper
+from ..core.lod import LENGTH_SUFFIX
+
+__all__ = ['data', 'py_reader', 'shuffle', 'batch', 'double_buffer',
+           'read_file', 'open_files', 'random_data_generator', 'load',
+           'create_py_reader_by_data', 'Preprocessor']
+
+
+def data(name, shape, dtype='float32', lod_level=0, type=None,
+         append_batch_size=True, stop_gradient=True):
+    """Declare an input variable (reference layers/io.py data())."""
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    if append_batch_size:
+        # negative dims inside shape are normalized to -1 like the ref
+        shape = [-1] + shape
+    shape = [d if (d is None or d >= 0) else -1 for d in shape]
+    if lod_level > 0:
+        # padded layout: [batch, time, *feature]
+        shape = [shape[0], -1] + shape[1:]
+    block = default_main_program().global_block()
+    var = block.create_var(name=name, shape=shape, dtype=dtype,
+                           lod_level=lod_level, is_data=True,
+                           stop_gradient=stop_gradient)
+    if lod_level > 0:
+        block.create_var(name=name + LENGTH_SUFFIX, shape=[-1],
+                         dtype='int32', is_data=True, stop_gradient=True)
+        var.lod_length_name = name + LENGTH_SUFFIX
+    return var
+
+
+class _PyReader(object):
+    """Host-side prefetching reader (parity: py_reader / double_buffer).
+
+    decorate_paddle_reader / decorate_tensor_provider feed a generator whose
+    batches are handed to Executor.run via feed dict by `next_feed()`.
+    """
+
+    def __init__(self, feed_list=None, capacity=64, shapes=None, dtypes=None,
+                 lod_levels=None, name=None):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self._gen = None
+        self._iter = None
+
+    def decorate_paddle_reader(self, reader, places=None):
+        self._gen = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+    decorate_batch_generator = decorate_paddle_reader
+    decorate_tensor_provider = decorate_paddle_reader
+
+    def start(self):
+        self._iter = iter(self._gen())
+
+    def reset(self):
+        self._iter = None
+
+    def next_feed(self):
+        if self._iter is None:
+            self.start()
+        try:
+            sample = next(self._iter)
+        except StopIteration:
+            self._iter = None
+            raise
+        feed = {}
+        for var, val in zip(self.feed_list, sample):
+            feed[var.name] = val
+        return feed
+
+
+def py_reader(capacity=64, shapes=None, dtypes=None, lod_levels=None,
+              name=None, use_double_buffer=True):
+    vars_ = []
+    for i, (s, d) in enumerate(zip(shapes, dtypes)):
+        lod = lod_levels[i] if lod_levels else 0
+        vars_.append(data('_py_reader_%s_%d' % (name or 'r', i),
+                          shape=list(s)[1:], dtype=d, lod_level=lod))
+    return _PyReader(feed_list=vars_, capacity=capacity)
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    return _PyReader(feed_list=feed_list, capacity=capacity)
+
+
+def read_file(reader):
+    return list(reader.feed_list)
+
+
+def shuffle(reader, buffer_size):
+    from ..reader import shuffle as _shuffle
+    if isinstance(reader, _PyReader):
+        return reader
+    return _shuffle(reader, buffer_size)
+
+
+def batch(reader, batch_size):
+    from ..batch import batch as _batch
+    if isinstance(reader, _PyReader):
+        return reader
+    return _batch(reader, batch_size)
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, is_test=None):
+    raise NotImplementedError(
+        'open_files: use paddle_tpu.native datafeed readers + py_reader')
+
+
+def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):
+    import numpy as np
+    vars_ = [data('_rand_gen_%d' % i, shape=list(s)[1:], dtype='float32')
+             for i, s in enumerate(shapes)]
+    r = _PyReader(feed_list=vars_)
+
+    def gen():
+        while True:
+            yield [np.random.uniform(low, high, size=s).astype('float32')
+                   for s in shapes]
+    r.decorate_paddle_reader(gen)
+    return r
+
+
+def load(out, file_path, load_as_fp16=None):
+    import numpy as np
+    val = np.load(file_path + '.npy')
+    from ..core.executor import global_scope
+    global_scope().set(out.name, val)
+
+
+class Preprocessor(object):
+    def __init__(self, reader, name=None):
+        self.reader = reader
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            yield
+        return cm()

@@ -1,0 +1,51 @@
+"""Metric layers: accuracy, auc.
+
+Parity: reference python/paddle/fluid/layers/metric_op.py.
+"""
+from ..core.layer_helper import LayerHelper
+from ..initializer import Constant
+
+__all__ = ['accuracy', 'auc']
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper('accuracy')
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='top_k', inputs={'X': input},
+                     outputs={'Out': values, 'Indices': indices},
+                     attrs={'k': k})
+    acc_out = helper.create_variable_for_type_inference('float32')
+    if correct is None:
+        correct = helper.create_variable_for_type_inference('int32')
+    if total is None:
+        total = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='accuracy',
+                     inputs={'Out': values, 'Indices': indices,
+                             'Label': label},
+                     outputs={'Accuracy': acc_out, 'Correct': correct,
+                              'Total': total}, attrs={})
+    return acc_out
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1):
+    helper = LayerHelper('auc')
+    stat_pos = helper.create_global_variable(
+        persistable=True, dtype='float32', shape=[num_thresholds + 1],
+        name=helper.name + '_stat_pos')
+    stat_neg = helper.create_global_variable(
+        persistable=True, dtype='float32', shape=[num_thresholds + 1],
+        name=helper.name + '_stat_neg')
+    for v in (stat_pos, stat_neg):
+        v.stop_gradient = True
+        helper.set_variable_initializer(v, Constant(0.0))
+    auc_out = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='auc',
+                     inputs={'Predict': input, 'Label': label,
+                             'StatPos': stat_pos, 'StatNeg': stat_neg},
+                     outputs={'AUC': auc_out, 'StatPosOut': stat_pos,
+                              'StatNegOut': stat_neg},
+                     attrs={'curve': curve,
+                            'num_thresholds': num_thresholds})
+    return auc_out, [stat_pos, stat_neg], [stat_pos, stat_neg]

@@ -1,0 +1,1581 @@
+"""fluid.layers.nn equivalents — the main model-building API.
+
+Parity: reference python/paddle/fluid/layers/nn.py (151 public functions).
+Each function builds graph ops; lowering is whole-block to XLA
+(core/executor.py).  Sequence layers operate on padded [B, T, ...] + length
+vars (see layers/io.py data(lod_level>0)).
+"""
+import numpy as np
+
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+from ..core.lod import LENGTH_SUFFIX
+from ..param_attr import ParamAttr
+from ..initializer import Constant, Normal, Xavier
+
+__all__ = [
+    'fc', 'embedding', 'dynamic_lstm', 'dynamic_lstmp', 'dynamic_gru',
+    'gru_unit', 'lstm', 'lstm_unit', 'conv2d', 'conv3d', 'conv2d_transpose',
+    'conv3d_transpose', 'pool2d', 'pool3d', 'adaptive_pool2d',
+    'adaptive_pool3d', 'batch_norm', 'data_norm', 'layer_norm', 'group_norm',
+    'softmax', 'softmax_with_cross_entropy', 'cross_entropy', 'bpr_loss',
+    'square_error_cost', 'cos_sim', 'dropout', 'split', 'matmul', 'topk',
+    'transpose', 'reshape', 'squeeze', 'unsqueeze', 'reduce_sum',
+    'reduce_mean', 'reduce_max', 'reduce_min', 'reduce_prod', 'l2_normalize',
+    'one_hot', 'lrn', 'pad', 'pad2d', 'pad_constant_like', 'label_smooth',
+    'image_resize', 'image_resize_short', 'resize_bilinear', 'resize_nearest',
+    'gather', 'scatter', 'random_crop', 'crop', 'relu', 'log', 'mean', 'mul',
+    'sigmoid_cross_entropy_with_logits', 'smooth_l1', 'huber_loss',
+    'log_loss', 'rank_loss', 'margin_rank_loss', 'nce', 'hsigmoid',
+    'multiplex', 'flatten', 'stack', 'unstack', 'expand', 'scale',
+    'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min',
+    'elementwise_pow', 'clip', 'clip_by_norm', 'slice', 'shape',
+    'logical_and', 'logical_or', 'logical_xor', 'logical_not', 'maxout',
+    'space_to_depth', 'affine_grid', 'affine_channel', 'grid_sampler',
+    'add_position_encoding', 'bilinear_tensor_product', 'prelu', 'brelu',
+    'leaky_relu', 'soft_relu', 'elu', 'relu6', 'pow', 'stanh',
+    'hard_sigmoid', 'swish', 'selu', 'mean_iou', 'dice_loss', 'im2sequence',
+    'row_conv', 'uniform_random_batch_size_like', 'gaussian_random',
+    'sampling_id', 'gaussian_random_batch_size_like', 'sum',
+    'shuffle_channel', 'similarity_focus', 'hash', 'lod_reset',
+    'autoincreased_step_counter', 'py_func',
+    # sequence family
+    'sequence_conv', 'sequence_pool', 'sequence_softmax', 'sequence_expand',
+    'sequence_expand_as', 'sequence_pad', 'sequence_unpad',
+    'sequence_first_step', 'sequence_last_step', 'sequence_slice',
+    'sequence_reshape', 'sequence_scatter', 'sequence_mask',
+    'sequence_enumerate', 'sequence_concat', 'sequence_reverse',
+    'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'chunk_eval',
+    'linear_chain_crf', 'crf_decoding', 'one_hot', 'group_norm',
+    'teacher_student_sigmoid_loss',
+]
+
+
+def _prod(xs):
+    return int(np.prod([int(x) for x in xs])) if len(xs) else 1
+
+
+def _copy_lod(x, out):
+    if isinstance(x, Variable) and x.lod_level > 0:
+        out.lod_level = x.lod_level
+        out.lod_length_name = getattr(x, 'lod_length_name', None)
+
+
+def _len_var(x):
+    """The companion int32 lengths Variable of a lod var, or None."""
+    name = getattr(x, 'lod_length_name', None)
+    if name is None and x.lod_level > 0:
+        name = x.name + LENGTH_SUFFIX
+    if name is None:
+        return None
+    try:
+        return x.block.var(name)
+    except ValueError:
+        return None
+
+
+def _seq_inputs(x, extra=None):
+    ins = {'X': x}
+    lv = _len_var(x)
+    if lv is not None:
+        ins['Length'] = lv
+    if extra:
+        ins.update(extra)
+    return ins
+
+
+# ------------------------------------------------------------------ fc
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    """Reference nn.py fc: y = act(x W + b); lowers to one MXU GEMM.
+    On padded sequence input [B, T, D] the weight applies per-token."""
+    helper = LayerHelper('fc', input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    inputs = helper.multiple_input()
+    param_attrs = helper.multiple_param_attr(len(inputs))
+    mul_results = []
+    ncd_final = num_flatten_dims
+    for input_var, p_attr in zip(inputs, param_attrs):
+        ncd = num_flatten_dims + (1 if input_var.lod_level > 0 else 0)
+        ncd_final = ncd
+        input_shape = input_var.shape
+        param_shape = [_prod(input_shape[ncd:]), size]
+        w = helper.create_parameter(p_attr, param_shape, dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type='mul', inputs={'X': input_var, 'Y': w},
+                         outputs={'Out': tmp},
+                         attrs={'x_num_col_dims': ncd, 'y_num_col_dims': 1})
+        _copy_lod(input_var, tmp)
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type='sum', inputs={'X': mul_results},
+                         outputs={'Out': pre_bias}, attrs={})
+        _copy_lod(inputs[0], pre_bias)
+    pre_act = helper.append_bias_op(pre_bias, dim_start=ncd_final)
+    _copy_lod(inputs[0], pre_act)
+    out = helper.append_activation(pre_act)
+    _copy_lod(inputs[0], out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32'):
+    """Reference nn.py embedding / lookup_table_op.  is_sparse is a no-op:
+    on TPU dense gathers are fast and the table can be mesh-sharded
+    (parallel/sharded_embedding.py)."""
+    helper = LayerHelper('embedding', param_attr=param_attr)
+    w = helper.create_parameter(helper.param_attr, size, dtype,
+                                default_initializer=Xavier())
+    out = helper.create_variable_for_type_inference(dtype)
+    padding_idx = -1 if padding_idx is None else \
+        (padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(type='lookup_table',
+                     inputs={'W': w, 'Ids': input},
+                     outputs={'Out': out},
+                     attrs={'padding_idx': padding_idx,
+                            'is_sparse': is_sparse})
+    _copy_lod(input, out)
+    return out
+
+
+# ------------------------------------------------------------------ RNN
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation='sigmoid', cell_activation='tanh',
+                 candidate_activation='tanh', dtype='float32', name=None):
+    """Reference dynamic_lstm (lstm_op): input is pre-projected [B,T,4D];
+    size = 4*D.  Lowered to a lax.scan recurrence with per-step masking."""
+    helper = LayerHelper('lstm', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    D = size // 4
+    weight = helper.create_parameter(helper.param_attr, [D, 4 * D], dtype)
+    bias_size = [1, 7 * D] if use_peepholes else [1, 4 * D]
+    bias = helper.create_parameter(helper.bias_attr, bias_size, dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = _seq_inputs(input)
+    ins = {'Input': ins['X'], 'Weight': weight, 'Bias': bias}
+    lv = _len_var(input)
+    if lv is not None:
+        ins['Length'] = lv
+    if h_0 is not None:
+        ins['H0'] = h_0
+    if c_0 is not None:
+        ins['C0'] = c_0
+    helper.append_op(type='lstm', inputs=ins,
+                     outputs={'Hidden': hidden, 'Cell': cell},
+                     attrs={'use_peepholes': use_peepholes,
+                            'is_reverse': is_reverse,
+                            'gate_activation': gate_activation,
+                            'cell_activation': cell_activation,
+                            'candidate_activation': candidate_activation})
+    _copy_lod(input, hidden)
+    _copy_lod(input, cell)
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation='sigmoid', cell_activation='tanh',
+                  candidate_activation='tanh', proj_activation='tanh',
+                  dtype='float32', name=None):
+    """LSTM with recurrent projection (ref lstmp_op)."""
+    helper = LayerHelper('lstmp', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    D = size // 4
+    weight = helper.create_parameter(helper.param_attr,
+                                     [proj_size, 4 * D], dtype)
+    proj_weight = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), [D, proj_size], dtype)
+    bias_size = [1, 7 * D] if use_peepholes else [1, 4 * D]
+    bias = helper.create_parameter(helper.bias_attr, bias_size, dtype,
+                                   is_bias=True)
+    projection = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    ins = {'Input': input, 'Weight': weight, 'ProjWeight': proj_weight,
+           'Bias': bias}
+    lv = _len_var(input)
+    if lv is not None:
+        ins['Length'] = lv
+    helper.append_op(type='lstmp', inputs=ins,
+                     outputs={'Projection': projection, 'Cell': cell},
+                     attrs={'use_peepholes': use_peepholes,
+                            'is_reverse': is_reverse,
+                            'gate_activation': gate_activation,
+                            'cell_activation': cell_activation,
+                            'candidate_activation': candidate_activation,
+                            'proj_activation': proj_activation})
+    _copy_lod(input, projection)
+    _copy_lod(input, cell)
+    return projection, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation='sigmoid',
+                candidate_activation='tanh', h_0=None, name=None):
+    helper = LayerHelper('gru', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    weight = helper.create_parameter(helper.param_attr, [size, 3 * size],
+                                     dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * size], dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    ins = {'Input': input, 'Weight': weight, 'Bias': bias}
+    lv = _len_var(input)
+    if lv is not None:
+        ins['Length'] = lv
+    if h_0 is not None:
+        ins['H0'] = h_0
+    helper.append_op(type='gru', inputs=ins, outputs={'Hidden': hidden},
+                     attrs={'is_reverse': is_reverse,
+                            'gate_activation': gate_activation,
+                            'activation': candidate_activation})
+    _copy_lod(input, hidden)
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation='tanh', gate_activation='sigmoid', name=None):
+    helper = LayerHelper('gru_unit', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = input.dtype
+    D = size // 3
+    weight = helper.create_parameter(helper.param_attr, [D, 3 * D], dtype)
+    bias = helper.create_parameter(helper.bias_attr, [1, 3 * D], dtype,
+                                   is_bias=True)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_hidden = helper.create_variable_for_type_inference(dtype)
+    updated = helper.create_variable_for_type_inference(dtype)
+    act_map = {'identity': 0, 'sigmoid': 1, 'tanh': 2, 'relu': 3}
+    helper.append_op(type='gru_unit',
+                     inputs={'Input': input, 'HiddenPrev': hidden,
+                             'Weight': weight, 'Bias': bias},
+                     outputs={'Hidden': updated, 'Gate': gate,
+                              'ResetHiddenPrev': reset_hidden},
+                     attrs={'activation': act_map[activation],
+                            'gate_activation': act_map[gate_activation]})
+    return updated, reset_hidden, gate
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Multi-layer (cudnn-style) LSTM, ref nn.py lstm().  Stacked scans."""
+    helper = LayerHelper('multilayer_lstm', name=name)
+    dtype = input.dtype
+    x = input
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        directions = []
+        for rev in ([False, True] if is_bidirec else [False]):
+            proj = fc(x, 4 * hidden_size, num_flatten_dims=2,
+                      bias_attr=False)
+            h, c = dynamic_lstm(proj, 4 * hidden_size, use_peepholes=False,
+                                is_reverse=rev)
+            directions.append((h, c))
+        if is_bidirec:
+            from .tensor import concat
+            x = concat([directions[0][0], directions[1][0]], axis=2)
+        else:
+            x = directions[0][0]
+        if dropout_prob > 0.0 and not is_test:
+            x = dropout(x, dropout_prob,
+                        dropout_implementation='upscale_in_train')
+        last_hs.append(directions[0][0])
+        last_cs.append(directions[0][1])
+    return x, last_hs[-1], last_cs[-1]
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper('lstm_unit', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = x_t.dtype
+    size = cell_t_prev.shape[1]
+    from .tensor import concat
+    concat_in = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(concat_in, 4 * size, param_attr=param_attr,
+                bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(dtype)
+    h = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='lstm_unit',
+                     inputs={'X': fc_out, 'C_prev': cell_t_prev},
+                     outputs={'C': c, 'H': h},
+                     attrs={'forget_bias': forget_bias})
+    return h, c
+
+
+# ------------------------------------------------------------------ conv
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper('conv2d', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation, dilation] if isinstance(dilation, int) else \
+        list(dilation)
+    filter_shape = [num_filters, num_channels // groups] + list(filter_size)
+    fan_in = (num_channels // groups) * _prod(filter_size)
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype,
+                                default_initializer=Normal(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='conv2d',
+                     inputs={'Input': input, 'Filter': w},
+                     outputs={'Output': pre_bias},
+                     attrs={'strides': stride, 'paddings': padding,
+                            'dilations': dilation, 'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper('conv3d', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+
+    def triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    filter_size = triple(filter_size)
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    fan_in = (num_channels // groups) * _prod(filter_size)
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype,
+                                default_initializer=Normal(
+                                    0.0, (2.0 / fan_in) ** 0.5))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='conv3d',
+                     inputs={'Input': input, 'Filter': w},
+                     outputs={'Output': pre_bias},
+                     attrs={'strides': triple(stride),
+                            'paddings': triple(padding),
+                            'dilations': triple(dilation), 'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper('conv2d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    stride = [stride, stride] if isinstance(stride, int) else list(stride)
+    padding = [padding, padding] if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+    if filter_size is None:
+        h_in, w_in = input.shape[2], input.shape[3]
+        out_size = [output_size] * 2 if isinstance(output_size, int) else \
+            list(output_size)
+        filter_size = [
+            (out_size[0] - (h_in - 1) * stride[0] + 2 * padding[0] - 1) //
+            dilation[0] + 1,
+            (out_size[1] - (w_in - 1) * stride[1] + 2 * padding[1] - 1) //
+            dilation[1] + 1]
+    elif isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    filter_shape = [num_channels, num_filters // groups] + list(filter_size)
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='conv2d_transpose',
+                     inputs={'Input': input, 'Filter': w},
+                     outputs={'Output': pre_bias},
+                     attrs={'strides': stride, 'paddings': padding,
+                            'dilations': dilation, 'groups': groups})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper('conv3d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+
+    def triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    filter_size = triple(filter_size)
+    filter_shape = [num_channels, num_filters] + filter_size
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='conv3d_transpose',
+                     inputs={'Input': input, 'Filter': w},
+                     outputs={'Output': pre_bias},
+                     attrs={'strides': triple(stride),
+                            'paddings': triple(padding),
+                            'dilations': triple(dilation)})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+# ------------------------------------------------------------------ pool
+
+def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper('pool2d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    helper.append_op(type='pool2d', inputs={'X': input}, outputs={'Out': out},
+                     attrs={'pooling_type': pool_type,
+                            'ksize': pair(pool_size),
+                            'strides': pair(pool_stride),
+                            'paddings': pair(pool_padding),
+                            'global_pooling': global_pooling,
+                            'ceil_mode': ceil_mode, 'exclusive': exclusive})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    helper = LayerHelper('pool3d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+
+    def triple(v):
+        return [v] * 3 if isinstance(v, int) else list(v)
+    helper.append_op(type='pool3d', inputs={'X': input}, outputs={'Out': out},
+                     attrs={'pooling_type': pool_type,
+                            'ksize': triple(pool_size),
+                            'strides': triple(pool_stride),
+                            'paddings': triple(pool_padding),
+                            'global_pooling': global_pooling,
+                            'ceil_mode': ceil_mode, 'exclusive': exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type='max', require_index=False,
+                    name=None):
+    helper = LayerHelper('adaptive_pool2d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='adaptive_pool2d', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'ksize': pool_size if isinstance(
+                         pool_size, (list, tuple)) else [pool_size] * 2,
+                         'pooling_type': pool_type})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type='max', require_index=False,
+                    name=None):
+    helper = LayerHelper('adaptive_pool3d', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='adaptive_pool3d', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'ksize': pool_size if isinstance(
+                         pool_size, (list, tuple)) else [pool_size] * 3,
+                         'pooling_type': pool_type})
+    return out
+
+
+# ------------------------------------------------------------------ norm
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=
+               False, fuse_with_relu=False, use_global_stats=False):
+    helper = LayerHelper('batch_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    channel_num = input.shape[1] if data_layout == 'NCHW' else input.shape[-1]
+    param_shape = [channel_num]
+    scale = helper.create_parameter(helper.param_attr, param_shape, dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(helper.bias_attr, param_shape, dtype,
+                                   is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, initializer=Constant(0.0),
+                  trainable=False), param_shape, dtype)
+    mean.stop_gradient = True
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, initializer=Constant(1.0),
+                  trainable=False), param_shape, dtype)
+    variance.stop_gradient = True
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='batch_norm',
+                     inputs={'X': input, 'Scale': scale, 'Bias': bias,
+                             'Mean': mean, 'Variance': variance},
+                     outputs={'Y': out, 'MeanOut': mean,
+                              'VarianceOut': variance,
+                              'SavedMean': saved_mean,
+                              'SavedVariance': saved_var},
+                     attrs={'momentum': momentum, 'epsilon': epsilon,
+                            'is_test': is_test, 'data_layout': data_layout,
+                            'use_global_stats': use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper('layer_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    param_shape = [_prod(input.shape[begin_norm_axis:])]
+    inputs = {'X': input}
+    if scale:
+        inputs['Scale'] = helper.create_parameter(
+            helper.param_attr, param_shape, dtype,
+            default_initializer=Constant(1.0))
+    if shift:
+        inputs['Bias'] = helper.create_parameter(
+            helper.bias_attr, param_shape, dtype, is_bias=True)
+    mean_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='layer_norm', inputs=inputs,
+                     outputs={'Y': out, 'Mean': mean_out,
+                              'Variance': var_out},
+                     attrs={'epsilon': epsilon,
+                            'begin_norm_axis': begin_norm_axis})
+    _copy_lod(input, out)
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout='NCHW', name=None):
+    helper = LayerHelper('group_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    param_shape = [input.shape[1]]
+    inputs = {'X': input}
+    if param_attr is not False:
+        inputs['Scale'] = helper.create_parameter(
+            helper.param_attr, param_shape, dtype,
+            default_initializer=Constant(1.0))
+    if bias_attr is not False:
+        inputs['Bias'] = helper.create_parameter(
+            helper.bias_attr, param_shape, dtype, is_bias=True)
+    mean_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    var_out = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='group_norm', inputs=inputs,
+                     outputs={'Y': out, 'Mean': mean_out,
+                              'Variance': var_out},
+                     attrs={'epsilon': epsilon, 'groups': groups})
+    return helper.append_activation(out)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout='NCHW', in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    helper = LayerHelper('data_norm', name=name)
+    dtype = input.dtype
+    c = input.shape[-1]
+    batch_size = helper.create_parameter(
+        ParamAttr(initializer=Constant(1e4), trainable=True), [c], dtype)
+    batch_sum = helper.create_parameter(
+        ParamAttr(initializer=Constant(0.0), trainable=True), [c], dtype)
+    batch_square_sum = helper.create_parameter(
+        ParamAttr(initializer=Constant(1e4), trainable=True), [c], dtype)
+    means = helper.create_variable_for_type_inference(dtype)
+    scales = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='data_norm',
+                     inputs={'X': input, 'BatchSize': batch_size,
+                             'BatchSum': batch_sum,
+                             'BatchSquareSum': batch_square_sum},
+                     outputs={'Y': out, 'Means': means, 'Scales': scales},
+                     attrs={'epsilon': epsilon})
+    return helper.append_activation(out)
+
+
+# -------------------------------------------------------------- generic
+
+def _simple(op_type, x, attrs=None, name=None, outs=('Out',), ins_name='X',
+            extra_ins=None, dtype=None, lod_from=None):
+    helper = LayerHelper(op_type, name=name)
+    dtype = dtype or (x[0].dtype if isinstance(x, (list, tuple)) else x.dtype)
+    out_vars = {o: helper.create_variable_for_type_inference(dtype)
+                for o in outs}
+    ins = {ins_name: x}
+    if extra_ins:
+        ins.update(extra_ins)
+    helper.append_op(type=op_type, inputs=ins, outputs=out_vars,
+                     attrs=attrs or {})
+    src = lod_from if lod_from is not None else (
+        x[0] if isinstance(x, (list, tuple)) else x)
+    for v in out_vars.values():
+        _copy_lod(src, v)
+    if len(outs) == 1:
+        return out_vars[outs[0]]
+    return tuple(out_vars[o] for o in outs)
+
+
+def softmax(input, use_cudnn=True, name=None, axis=-1):
+    return _simple('softmax', input, {'axis': axis}, name)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return _simple('cross_entropy', input,
+                   {'soft_label': soft_label, 'ignore_index': ignore_index},
+                   outs=('Y',), extra_ins={'Label': label})
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False):
+    helper = LayerHelper('softmax_with_cross_entropy')
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    softmax_out = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(type='softmax_with_cross_entropy',
+                     inputs={'Logits': logits, 'Label': label},
+                     outputs={'Loss': loss, 'Softmax': softmax_out},
+                     attrs={'soft_label': soft_label,
+                            'ignore_index': ignore_index})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def bpr_loss(input, label, name=None):
+    return _simple('bpr_loss', input, name=name, outs=('Y',),
+                   extra_ins={'Label': label})
+
+
+def square_error_cost(input, label):
+    return _simple('square_error_cost', input, extra_ins={'Y': label})
+
+
+def cos_sim(X, Y):
+    return _simple('cos_sim', X, outs=('Out', 'XNorm', 'YNorm'),
+                   extra_ins={'Y': Y})[0]
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation='downgrade_in_infer'):
+    return _simple('dropout', x,
+                   {'dropout_prob': dropout_prob, 'is_test': is_test,
+                    'seed': seed if seed is not None else 0,
+                    'dropout_implementation': dropout_implementation},
+                   name, outs=('Out', 'Mask'))[0]
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper('split', name=name)
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = 0
+        sections = list(num_or_sections)
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(max(num, len(sections)) or 1)]
+    helper.append_op(type='split', inputs={'X': input}, outputs={'Out': outs},
+                     attrs={'axis': dim, 'num': num, 'sections': sections})
+    return outs
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    return _simple('matmul', x, {'transpose_X': transpose_x,
+                                 'transpose_Y': transpose_y,
+                                 'alpha': float(alpha)}, name,
+                   extra_ins={'Y': y})
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    return _simple('mul', x, {'x_num_col_dims': x_num_col_dims,
+                              'y_num_col_dims': y_num_col_dims}, name,
+                   extra_ins={'Y': y})
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper('top_k', name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='top_k', inputs={'X': input},
+                     outputs={'Out': values, 'Indices': indices},
+                     attrs={'k': k})
+    return values, indices
+
+
+def transpose(x, perm, name=None):
+    return _simple('transpose', x, {'axis': list(perm)}, name)
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper('reshape', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='reshape', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'shape': list(shape)})
+    return helper.append_activation(out, act)
+
+
+def squeeze(input, axes, name=None):
+    return _simple('squeeze', input, {'axes': list(axes)}, name)
+
+
+def unsqueeze(input, axes, name=None):
+    return _simple('unsqueeze', input, {'axes': list(axes)}, name)
+
+
+def _reduce(op, input, dim, keep_dim, name):
+    if dim is None:
+        attrs = {'reduce_all': True, 'keep_dim': keep_dim}
+    else:
+        attrs = {'dim': [dim] if isinstance(dim, int) else list(dim),
+                 'keep_dim': keep_dim}
+    return _simple(op, input, attrs, name)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_sum', input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_mean', input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_max', input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_min', input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce('reduce_prod', input, dim, keep_dim, name)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _simple('l2_normalize', x, {'axis': axis, 'epsilon': epsilon},
+                   name, outs=('Out', 'Norm'))[0]
+
+
+def one_hot(input, depth):
+    return _simple('one_hot', input, {'depth': depth})
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    return _simple('lrn', input, {'n': n, 'k': k, 'alpha': alpha,
+                                  'beta': beta}, name,
+                   outs=('Out', 'MidOut'))[0]
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _simple('pad', x, {'paddings': list(paddings),
+                              'pad_value': float(pad_value)}, name)
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode='constant', pad_value=0.0,
+          data_format='NCHW', name=None):
+    return _simple('pad2d', input, {'paddings': list(paddings),
+                                    'mode': mode, 'pad_value': pad_value,
+                                    'data_format': data_format}, name)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    return _simple('pad_constant_like', x, {'pad_value': float(pad_value)},
+                   name, extra_ins={'Y': y}, lod_from=y)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype='float32',
+                 name=None):
+    extra = {'PriorDist': prior_dist} if prior_dist is not None else None
+    return _simple('label_smooth', label, {'epsilon': float(epsilon)}, name,
+                   extra_ins=extra)
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample='BILINEAR', actual_shape=None, align_corners=True,
+                 align_mode=1):
+    op = 'bilinear_interp' if resample == 'BILINEAR' else 'nearest_interp'
+    if out_shape is None:
+        out_shape = [int(input.shape[2] * scale), int(input.shape[3] * scale)]
+    return _simple(op, input, {'out_h': int(out_shape[0]),
+                               'out_w': int(out_shape[1]),
+                               'align_corners': align_corners}, name)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, 'BILINEAR',
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    return image_resize(input, out_shape, scale, name, 'NEAREST',
+                        actual_shape, align_corners)
+
+
+def image_resize_short(input, out_short_len, resample='BILINEAR'):
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    out_shape = [int(h * out_short_len / short),
+                 int(w * out_short_len / short)]
+    return image_resize(input, out_shape, resample=resample)
+
+
+def gather(input, index):
+    return _simple('gather', input, extra_ins={'Index': index})
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    return _simple('scatter', input, {'overwrite': overwrite}, name,
+                   extra_ins={'Ids': index, 'Updates': updates})
+
+
+def random_crop(x, shape, seed=None):
+    return _simple('random_crop', x, {'shape': list(shape),
+                                      'seed': seed or 0})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    attrs = {}
+    extra = None
+    if isinstance(shape, Variable):
+        extra = {'Y': shape}
+    else:
+        attrs['shape'] = list(shape)
+    attrs['offsets'] = list(offsets) if offsets else None
+    return _simple('crop', x, attrs, name, extra_ins=extra)
+
+
+def relu(x, name=None):
+    return _simple('relu', x, name=name)
+
+
+def log(x, name=None):
+    return _simple('log', x, name=name)
+
+
+def mean(x, name=None):
+    return _simple('mean', x, name=name)
+
+
+def sum(x):
+    return _simple('sum', x if isinstance(x, list) else [x])
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    return _simple('sigmoid_cross_entropy_with_logits', x,
+                   {'ignore_index': ignore_index, 'normalize': normalize},
+                   name, extra_ins={'Label': label})
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    extra = {'Y': y}
+    if inside_weight is not None:
+        extra['InsideWeight'] = inside_weight
+    if outside_weight is not None:
+        extra['OutsideWeight'] = outside_weight
+    return _simple('smooth_l1_loss', x, {'sigma': sigma or 1.0},
+                   outs=('Out', 'Diff'), extra_ins=extra)[0]
+
+
+def huber_loss(input, label, delta):
+    return _simple('huber_loss', input, {'delta': float(delta)},
+                   outs=('Out', 'Residual'), extra_ins={'Y': label})[0]
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper('log_loss', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='log_loss',
+                     inputs={'Predicted': input, 'Labels': label},
+                     outputs={'Loss': out}, attrs={'epsilon': epsilon})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper('rank_loss', name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type='rank_loss',
+                     inputs={'Label': label, 'Left': left, 'Right': right},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper('margin_rank_loss', name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type='margin_rank_loss',
+                     inputs={'Label': label, 'X1': left, 'X2': right},
+                     outputs={'Out': out, 'Activated': act},
+                     attrs={'margin': margin})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None, name=None, sampler='uniform',
+        custom_dist=None, seed=0, is_sparse=False):
+    helper = LayerHelper('nce', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(helper.param_attr,
+                                [num_total_classes, dim], input.dtype)
+    inputs = {'Input': input, 'Label': label, 'Weight': w}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr,
+                                    [num_total_classes, 1], input.dtype,
+                                    is_bias=True)
+        inputs['Bias'] = b
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype)
+    slab = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='nce', inputs=inputs,
+                     outputs={'Cost': cost, 'SampleLogits': sl,
+                              'SampleLabels': slab},
+                     attrs={'num_total_classes': num_total_classes,
+                            'num_neg_samples': num_neg_samples or 10,
+                            'seed': seed})
+    return cost / (1 + (num_neg_samples or 10))
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    helper = LayerHelper('hierarchical_sigmoid', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[1]
+    w = helper.create_parameter(helper.param_attr, [num_classes - 1, dim],
+                                input.dtype)
+    inputs = {'X': input, 'Label': label, 'W': w}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, [num_classes - 1, 1],
+                                    input.dtype, is_bias=True)
+        inputs['Bias'] = b
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='hierarchical_sigmoid', inputs=inputs,
+                     outputs={'Out': out, 'PreOut': pre_out},
+                     attrs={'num_classes': num_classes})
+    return out
+
+
+def multiplex(inputs, index):
+    return _simple('multiplex', inputs, ins_name='X',
+                   extra_ins={'Ids': index})
+
+
+def flatten(x, axis=1, name=None):
+    return _simple('flatten', x, {'axis': axis}, name)
+
+
+def stack(x, axis=0):
+    x = x if isinstance(x, list) else [x]
+    return _simple('stack', x, {'axis': axis}, outs=('Y',))
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper('unstack')
+    num = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op(type='unstack', inputs={'X': x}, outputs={'Y': outs},
+                     attrs={'axis': axis})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    return _simple('expand', x, {'expand_times': list(expand_times)}, name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper('scale', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='scale', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'scale': float(scale), 'bias': float(bias),
+                            'bias_after_scale': bias_after_scale})
+    _copy_lod(x, out)
+    return helper.append_activation(out, act)
+
+
+def _elementwise(op, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type=op, inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={'axis': axis})
+    _copy_lod(x, out)
+    return helper.append_activation(out, act)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_add', x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_sub', x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_mul', x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_div', x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_max', x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_min', x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise('elementwise_pow', x, y, axis, act, name)
+
+
+def clip(x, min, max, name=None):
+    return _simple('clip', x, {'min': float(min), 'max': float(max)}, name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _simple('clip_by_norm', x, {'max_norm': float(max_norm)}, name)
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper('slice')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='slice', inputs={'Input': input},
+                     outputs={'Out': out},
+                     attrs={'axes': list(axes), 'starts': list(starts),
+                            'ends': list(ends)})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper('shape')
+    out = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='shape', inputs={'Input': input},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    return _simple('logical_and', x, extra_ins={'Y': y}, dtype='bool')
+
+
+def logical_or(x, y, out=None, name=None):
+    return _simple('logical_or', x, extra_ins={'Y': y}, dtype='bool')
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _simple('logical_xor', x, extra_ins={'Y': y}, dtype='bool')
+
+
+def logical_not(x, out=None, name=None):
+    return _simple('logical_not', x, dtype='bool')
+
+
+def maxout(x, groups, name=None):
+    return _simple('maxout', x, {'groups': groups}, name)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _simple('space_to_depth', x, {'blocksize': blocksize}, name)
+
+
+def affine_grid(theta, out_shape, name=None):
+    return _simple('affine_grid', theta,
+                   {'output_shape': list(out_shape)},
+                   name, ins_name='Theta', outs=('Output',))
+
+
+def affine_channel(x, scale=None, bias=None, data_layout='NCHW', name=None):
+    return _simple('affine_channel', x, name=name,
+                   extra_ins={'Scale': scale, 'Bias': bias})
+
+
+def grid_sampler(x, grid, name=None):
+    return _simple('grid_sampler', x, name=name,
+                   extra_ins={'Grid': grid}, outs=('Output',))
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    return _simple('add_position_encoding', input,
+                   {'alpha': float(alpha), 'beta': float(beta)}, name)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper('bilinear_tensor_product', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = x.dtype
+    w = helper.create_parameter(helper.param_attr,
+                                [size, x.shape[1], y.shape[1]], dtype)
+    inputs = {'X': x, 'Y': y, 'Weight': w}
+    if helper.bias_attr is not False:
+        inputs['Bias'] = helper.create_parameter(
+            helper.bias_attr, [1, size], dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='bilinear_tensor_product', inputs=inputs,
+                     outputs={'Out': out}, attrs={})
+    return helper.append_activation(out)
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper('prelu', param_attr=param_attr, name=name)
+    alpha_shape = [1]
+    if mode == 'channel':
+        alpha_shape = [x.shape[1]]
+    elif mode == 'element':
+        alpha_shape = list(x.shape[1:])
+    alpha = helper.create_parameter(helper.param_attr, alpha_shape, x.dtype,
+                                    default_initializer=Constant(0.25))
+    return _simple('prelu', x, {'mode': mode}, name,
+                   extra_ins={'Alpha': alpha})
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _simple('brelu', x, {'t_min': t_min, 't_max': t_max}, name)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _simple('leaky_relu', x, {'alpha': alpha}, name)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _simple('soft_relu', x, {'threshold': threshold}, name)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _simple('elu', x, {'alpha': alpha}, name)
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _simple('relu6', x, {'threshold': threshold}, name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _simple('pow', x, {'factor': factor}, name)
+
+
+def stanh(x, scale_a=2.0 / 3.0, scale_b=1.7159, name=None):
+    return _simple('stanh', x, {'scale_a': scale_a, 'scale_b': scale_b},
+                   name)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _simple('hard_sigmoid', x, {'slope': slope, 'offset': offset},
+                   name)
+
+
+def swish(x, beta=1.0, name=None):
+    return _simple('swish', x, {'beta': beta}, name)
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs['scale'] = scale
+    if alpha is not None:
+        attrs['alpha'] = alpha
+    return _simple('selu', x, attrs, name)
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper('mean_iou')
+    miou = helper.create_variable_for_type_inference('float32')
+    wrong = helper.create_variable_for_type_inference('float32')
+    correct = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='mean_iou',
+                     inputs={'Predictions': input, 'Labels': label},
+                     outputs={'OutMeanIou': miou, 'OutWrong': wrong,
+                              'OutCorrect': correct},
+                     attrs={'num_classes': num_classes})
+    return miou, wrong, correct
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return _simple('dice_loss', input, {'epsilon': epsilon},
+                   extra_ins={'Label': label})
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=
+                None, out_stride=1, name=None):
+    def pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    return _simple('im2sequence', input,
+                   {'kernels': pair(filter_size), 'strides': pair(stride),
+                    'paddings': pair(padding)}, name)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper('row_conv', param_attr=param_attr, act=act)
+    dtype = input.dtype
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='row_conv',
+                     inputs={'X': input, 'Filter': w},
+                     outputs={'Out': out}, attrs={})
+    _copy_lod(input, out)
+    return helper.append_activation(out)
+
+
+def uniform_random_batch_size_like(input, shape, dtype='float32',
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    return _simple('uniform_random_batch_size_like', input,
+                   {'shape': list(shape), 'input_dim_idx': input_dim_idx,
+                    'output_dim_idx': output_dim_idx, 'min': min, 'max': max,
+                    'seed': seed, 'dtype': dtype},
+                   ins_name='Input', dtype=dtype)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype='float32'):
+    helper = LayerHelper('gaussian_random')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='gaussian_random', inputs={},
+                     outputs={'Out': out},
+                     attrs={'shape': list(shape), 'mean': mean, 'std': std,
+                            'seed': seed, 'dtype': dtype})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype='float32'):
+    return _simple('gaussian_random_batch_size_like', input,
+                   {'shape': list(shape), 'input_dim_idx': input_dim_idx,
+                    'output_dim_idx': output_dim_idx, 'mean': mean,
+                    'std': std, 'seed': seed, 'dtype': dtype},
+                   ins_name='Input', dtype=dtype)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='float32'):
+    return _simple('sampling_id', x, {'seed': seed}, dtype='int64')
+
+
+def shuffle_channel(x, group, name=None):
+    return _simple('shuffle_channel', x, {'group': group}, name)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    return _simple('similarity_focus', input,
+                   {'axis': axis, 'indexes': list(indexes)}, name)
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _simple('hash', input, {'mod_by': hash_size,
+                                   'num_hash': num_hash}, name,
+                   dtype='int64')
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """In padded representation the data layout is unchanged; only the
+    lengths binding moves (ref lod_reset_op)."""
+    helper = LayerHelper('lod_reset')
+    out = _simple('assign', x)
+    if y is not None:
+        out.lod_level = max(1, y.lod_level)
+        out.lod_length_name = getattr(y, 'lod_length_name', None)
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    helper = LayerHelper('global_step_counter')
+    counter_name = counter_name or '@STEP_COUNTER@'
+    counter = helper.create_or_get_global_variable(
+        name=counter_name, dtype='int64', shape=[1], persistable=True)
+    if counter.op is None:
+        from ..initializer import Constant
+        Constant(value=float(begin - 1))(counter)
+        helper.append_op(type='increment', inputs={'X': counter},
+                         outputs={'Out': counter},
+                         attrs={'step': float(step)})
+        counter.stop_gradient = True
+    return counter
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=
+            None):
+    raise NotImplementedError(
+        'py_func executes arbitrary Python inside the graph; under XLA use '
+        'jax.pure_callback via paddle_tpu.ops registration instead')
+
+
+# ------------------------------------------------------- sequence family
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper('sequence_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    w = helper.create_parameter(helper.param_attr, filter_shape, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='sequence_conv',
+                     inputs=_seq_inputs(input, {'Filter': w}),
+                     outputs={'Out': out},
+                     attrs={'contextStride': filter_stride,
+                            'contextStart': -int(filter_size // 2),
+                            'contextLength': filter_size})
+    _copy_lod(input, out)
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    _copy_lod(input, pre_act)
+    res = helper.append_activation(pre_act)
+    _copy_lod(input, res)
+    return res
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    helper = LayerHelper('sequence_pool')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_pool', inputs=_seq_inputs(input),
+                     outputs={'Out': out},
+                     attrs={'pooltype': pool_type.upper(),
+                            'is_test': is_test})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, 'first')
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, 'last')
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper('sequence_softmax', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_softmax', inputs=_seq_inputs(input),
+                     outputs={'Out': out}, attrs={})
+    _copy_lod(input, out)
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper('sequence_expand', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='sequence_expand', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={'ref_level': ref_level})
+    _copy_lod(y, out)
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper('sequence_expand_as', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='sequence_expand_as', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={})
+    _copy_lod(y, out)
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper('sequence_pad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='sequence_pad',
+                     inputs=_seq_inputs(x, {'PadValue': pad_value}),
+                     outputs={'Out': out, 'Length': length},
+                     attrs={'padded_length': maxlen or -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper('sequence_unpad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='sequence_unpad',
+                     inputs={'X': x, 'Length': length},
+                     outputs={'Out': out, 'OutLength': out_len},
+                     attrs={})
+    out.lod_level = 1
+    out.lod_length_name = out_len.name
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper('sequence_slice', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_slice',
+                     inputs={'X': input, 'Offset': offset, 'Length': length},
+                     outputs={'Out': out}, attrs={})
+    _copy_lod(input, out)
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper('sequence_reshape')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_reshape', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'new_dim': new_dim})
+    _copy_lod(input, out)
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper('sequence_scatter', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_scatter',
+                     inputs={'X': input, 'Ids': index, 'Updates': updates},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    helper = LayerHelper('sequence_mask', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='sequence_mask', inputs={'X': x},
+                     outputs={'Y': out},
+                     attrs={'maxlen': maxlen if maxlen is not None else -1,
+                            'out_dtype': dtype})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper('sequence_enumerate', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='sequence_enumerate', inputs={'X': input},
+                     outputs={'Out': out},
+                     attrs={'win_size': win_size, 'pad_value': pad_value})
+    _copy_lod(input, out)
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper('sequence_concat', name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type='sequence_concat', inputs={'X': input},
+                     outputs={'Out': out}, attrs={})
+    _copy_lod(input[0], out)
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper('sequence_reverse', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='sequence_reverse', inputs=_seq_inputs(x),
+                     outputs={'Y': out}, attrs={})
+    _copy_lod(x, out)
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            use_cudnn=False):
+    helper = LayerHelper('warpctc')
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    ins = {'Logits': input, 'Label': label}
+    lv = _len_var(input)
+    if lv is not None:
+        ins['LogitsLength'] = lv
+    llv = _len_var(label)
+    if llv is not None:
+        ins['LabelLength'] = llv
+    helper.append_op(type='warpctc', inputs=ins, outputs={'Loss': loss},
+                     attrs={'blank': blank, 'norm_by_times': norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    helper = LayerHelper('ctc_greedy_decoder', name=name)
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='ctc_align', inputs=_seq_inputs(input),
+                     outputs={'Output': out}, attrs={'blank': blank,
+                                                     'merge_repeated': True})
+    _copy_lod(input, out)
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    helper = LayerHelper('edit_distance')
+    out = helper.create_variable_for_type_inference('float32')
+    seq_num = helper.create_variable_for_type_inference('int64')
+    ins = {'Hyps': input, 'Refs': label}
+    lv = _len_var(input)
+    if lv is not None:
+        ins['HypsLength'] = lv
+    llv = _len_var(label)
+    if llv is not None:
+        ins['RefsLength'] = llv
+    helper.append_op(type='edit_distance', inputs=ins,
+                     outputs={'Out': out, 'SequenceNum': seq_num},
+                     attrs={'normalized': normalized})
+    return out, seq_num
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None):
+    raise NotImplementedError(
+        'chunk_eval: use paddle_tpu.metrics.ChunkEvaluator (host-side)')
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    helper = LayerHelper('linear_chain_crf', param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(helper.param_attr,
+                                         [size + 2, size], input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='linear_chain_crf',
+                     inputs=_seq_inputs(input, {'Transition': transition,
+                                                'Label': label}),
+                     outputs={'Alpha': alpha, 'EmissionExps': emission_exps,
+                              'TransitionExps': transition_exps,
+                              'LogLikelihood': log_likelihood},
+                     attrs={})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    helper = LayerHelper('crf_decoding', param_attr=param_attr)
+    transition = helper.param_attr.name
+    tvar = input.block.var(transition)
+    out = helper.create_variable_for_type_inference('int64')
+    ins = _seq_inputs(input, {'Transition': tvar})
+    if label is not None:
+        ins['Label'] = label
+    helper.append_op(type='crf_decoding', inputs=ins,
+                     outputs={'ViterbiPath': out}, attrs={})
+    _copy_lod(input, out)
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    return _simple('teacher_student_sigmoid_loss', input,
+                   {'soft_max_up_bound': soft_max_up_bound,
+                    'soft_max_lower_bound': soft_max_lower_bound},
+                   outs=('Y',), extra_ins={'Label': label})

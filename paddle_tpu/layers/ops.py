@@ -1,0 +1,83 @@
+"""Generated thin wrappers for simple unary ops.
+
+Parity: reference python/paddle/fluid/layers/ops.py +
+layer_function_generator.py.
+"""
+from ..core.layer_helper import LayerHelper
+
+__all__ = [
+    'sigmoid', 'logsigmoid', 'exp', 'tanh', 'tanh_shrink', 'softshrink',
+    'sqrt', 'rsqrt', 'abs', 'ceil', 'floor', 'cos', 'sin', 'round',
+    'reciprocal', 'square', 'softplus', 'softsign', 'uniform_random',
+    'cumsum', 'thresholded_relu', 'hard_shrink', 'sign', 'erf',
+]
+
+
+def _make_unary(op_type):
+    def func(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type, inputs={'X': x},
+                         outputs={'Out': out}, attrs={})
+        if x.lod_level > 0:
+            out.lod_level = x.lod_level
+            out.lod_length_name = getattr(x, 'lod_length_name', None)
+        return out
+    func.__name__ = op_type
+    func.__doc__ = 'Elementwise %s (generated; ref layers/ops.py).' % op_type
+    return func
+
+
+for _op in ['sigmoid', 'logsigmoid', 'exp', 'tanh', 'tanh_shrink', 'sqrt',
+            'rsqrt', 'abs', 'ceil', 'floor', 'cos', 'sin', 'round',
+            'reciprocal', 'square', 'softplus', 'softsign', 'sign', 'erf']:
+    globals()[_op] = _make_unary(_op)
+
+
+def softshrink(x, alpha=None, name=None):
+    helper = LayerHelper('softshrink', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='softshrink', inputs={'X': x},
+                     outputs={'Out': out},
+                     attrs={'lambda': alpha if alpha is not None else 0.5})
+    return out
+
+
+def hard_shrink(x, threshold=None, name=None):
+    helper = LayerHelper('hard_shrink', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='hard_shrink', inputs={'X': x},
+                     outputs={'Out': out},
+                     attrs={'threshold': threshold if threshold is not None
+                            else 0.5})
+    return out
+
+
+def thresholded_relu(x, threshold=None, name=None):
+    helper = LayerHelper('thresholded_relu', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='thresholded_relu', inputs={'X': x},
+                     outputs={'Out': out},
+                     attrs={'threshold': threshold if threshold is not None
+                            else 1.0})
+    return out
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None, name=None):
+    helper = LayerHelper('cumsum', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='cumsum', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis if axis is not None else -1,
+                            'exclusive': bool(exclusive),
+                            'reverse': bool(reverse)})
+    return out
+
+
+def uniform_random(shape, dtype='float32', min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper('uniform_random')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='uniform_random', inputs={},
+                     outputs={'Out': out},
+                     attrs={'shape': list(shape), 'min': min, 'max': max,
+                            'seed': seed, 'dtype': dtype})
+    return out

@@ -1,0 +1,204 @@
+"""Tensor creation / manipulation layers.
+
+Parity: reference python/paddle/fluid/layers/tensor.py.
+"""
+import numpy as np
+
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+from ..core import unique_name
+
+__all__ = [
+    'create_tensor', 'create_parameter', 'create_global_var', 'cast',
+    'tensor_array_to_tensor', 'concat', 'sums', 'assign',
+    'fill_constant_batch_size_like', 'fill_constant', 'argmin', 'argmax',
+    'argsort', 'ones', 'zeros', 'reverse', 'has_inf', 'has_nan', 'isfinite',
+    'zeros_like',
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper('create_tensor', name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..param_attr import ParamAttr
+    helper = LayerHelper('create_parameter', name=name)
+    attr = ParamAttr._to_attr(attr)
+    if name is not None and attr.name is None:
+        attr.name = name
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..initializer import Constant
+    helper = LayerHelper('global_var', name=name)
+    var = helper.create_global_variable(
+        name=name or unique_name.generate('global_var'), dtype=dtype,
+        shape=shape, persistable=persistable)
+    helper.set_variable_initializer(var, Constant(value))
+    return var
+
+
+def cast(x, dtype):
+    from ..core.dtypes import dtype_str
+    helper = LayerHelper('cast')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='cast', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'in_dtype': x.dtype,
+                            'out_dtype': dtype_str(dtype)})
+    if x.lod_level > 0:
+        out.lod_level = x.lod_level
+        out.lod_length_name = getattr(x, 'lod_length_name', None)
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper('concat', name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type='concat', inputs={'X': input},
+                     outputs={'Out': out}, attrs={'axis': axis})
+    if input[0].lod_level > 0:
+        out.lod_level = input[0].lod_level
+        out.lod_length_name = getattr(input[0], 'lod_length_name', None)
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper('sum')
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type='sum', inputs={'X': input}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper('assign')
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(type='assign', inputs={'X': input},
+                         outputs={'Out': output}, attrs={})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(arr.dtype))
+        helper.append_op(type='assign_value', inputs={},
+                         outputs={'Out': output},
+                         attrs={'shape': list(arr.shape),
+                                'values': arr.reshape(-1).tolist(),
+                                'dtype': str(arr.dtype)})
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper('fill_constant')
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='fill_constant', inputs={},
+                     outputs={'Out': out},
+                     attrs={'shape': [int(s) for s in shape],
+                            'value': float(value), 'dtype': out.dtype})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper('fill_constant_batch_size_like')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='fill_constant_batch_size_like',
+                     inputs={'Input': input}, outputs={'Out': out},
+                     attrs={'shape': list(shape), 'value': float(value),
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx,
+                            'dtype': dtype})
+    out.stop_gradient = True
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper('arg_min')
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='arg_min', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    return out
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper('arg_max')
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='arg_max', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': axis})
+    return out
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper('argsort', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='argsort', inputs={'X': input},
+                     outputs={'Out': out, 'Indices': ids},
+                     attrs={'axis': axis})
+    return out, ids
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper('zeros_like')
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='fill_zeros_like', inputs={'X': x},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper('reverse')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='reverse', inputs={'X': x}, outputs={'Out': out},
+                     attrs={'axis': [axis] if isinstance(axis, int)
+                            else list(axis)})
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper('isinf')
+    out = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='has_inf', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper('isnan')
+    out = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='has_nan', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper('isfinite')
+    out = helper.create_variable_for_type_inference('bool')
+    helper.append_op(type='isfinite', inputs={'X': x}, outputs={'Out': out},
+                     attrs={})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    return concat(input, axis=axis, name=name), None

@@ -1,0 +1,244 @@
+"""Host-side streaming metrics (parity: python/paddle/fluid/metrics.py)."""
+import numpy as np
+
+__all__ = ['MetricBase', 'CompositeMetric', 'Precision', 'Recall', 'Accuracy',
+           'ChunkEvaluator', 'EditDistance', 'DetectionMAP', 'Auc']
+
+
+class MetricBase(object):
+    def __init__(self, name):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def __str__(self):
+        return self._name
+
+    def reset(self):
+        states = {attr: value for attr, value in self.__dict__.items()
+                  if not attr.startswith('_')}
+        for attr, value in states.items():
+            if isinstance(value, int):
+                setattr(self, attr, 0)
+            elif isinstance(value, float):
+                setattr(self, attr, .0)
+            elif isinstance(value, (np.ndarray, np.generic)):
+                setattr(self, attr, np.zeros_like(value))
+            else:
+                setattr(self, attr, None)
+
+    def update(self, preds, labels):
+        raise NotImplementedError()
+
+    def eval(self):
+        raise NotImplementedError()
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super(CompositeMetric, self).__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super(Precision, self).__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype('int32').reshape(-1)
+        labels = np.asarray(labels).astype('int32').reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels != 1)))
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else .0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super(Recall, self).__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype('int32').reshape(-1)
+        labels = np.asarray(labels).astype('int32').reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds != 1) & (labels == 1)))
+
+    def eval(self):
+        recall = self.tp + self.fn
+        return float(self.tp) / recall if recall != 0 else .0
+
+
+class Accuracy(MetricBase):
+    def __init__(self, name=None):
+        super(Accuracy, self).__init__(name)
+        self.value = .0
+        self.weight = .0
+
+    def update(self, value, weight):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError('weight is 0: call update first')
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    def __init__(self, name=None):
+        super(ChunkEvaluator, self).__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = float(self.num_correct_chunks) / self.num_infer_chunks \
+            if self.num_infer_chunks else 0.
+        recall = float(self.num_correct_chunks) / self.num_label_chunks \
+            if self.num_label_chunks else 0.
+        f1 = 2 * precision * recall / (precision + recall) \
+            if self.num_correct_chunks else 0.
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super(EditDistance, self).__init__(name)
+        self.total_distance = .0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances)
+        self.total_distance += float(distances.sum())
+        self.seq_num += int(seq_num)
+        self.instance_error += int((distances > 0).sum())
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError('no data: call update first')
+        avg_distance = self.total_distance / self.seq_num
+        avg_instance_error = self.instance_error / float(self.seq_num)
+        return avg_distance, avg_instance_error
+
+
+class Auc(MetricBase):
+    def __init__(self, name, curve='ROC', num_thresholds=4095):
+        super(Auc, self).__init__(name)
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._stat_pos = np.zeros(num_thresholds + 1)
+        self._stat_neg = np.zeros(num_thresholds + 1)
+
+    def update(self, preds, labels):
+        labels = np.asarray(labels).reshape(-1)
+        preds = np.asarray(preds)
+        p1 = preds[:, -1] if preds.ndim == 2 else preds.reshape(-1)
+        buckets = np.clip((p1 * self._num_thresholds).astype(int), 0,
+                          self._num_thresholds)
+        for b, l in zip(buckets, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    @staticmethod
+    def trapezoid_area(x1, x2, y1, y2):
+        return abs(x1 - x2) * (y1 + y2) / 2.0
+
+    def eval(self):
+        tot_pos = tot_neg = auc = 0.0
+        idx = self._num_thresholds
+        while idx >= 0:
+            tot_pos_prev, tot_neg_prev = tot_pos, tot_neg
+            tot_pos += self._stat_pos[idx]
+            tot_neg += self._stat_neg[idx]
+            auc += self.trapezoid_area(tot_neg, tot_neg_prev, tot_pos,
+                                       tot_pos_prev)
+            idx -= 1
+        return auc / tot_pos / tot_neg if tot_pos > 0.0 and tot_neg > 0.0 \
+            else 0.0
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision over accumulated detection results."""
+
+    def __init__(self, name=None, overlap_threshold=0.5, ap_version=
+                 'integral', class_num=None):
+        super(DetectionMAP, self).__init__(name)
+        self._overlap = overlap_threshold
+        self._ap_version = ap_version
+        self._class_num = class_num
+        self._records = []  # (label, score, tp)
+
+    def update(self, detections, gt_boxes, gt_labels):
+        """detections: [M, 6] (label, score, x1, y1, x2, y2) per image."""
+        det = np.asarray(detections)
+        gtb = np.asarray(gt_boxes)
+        gtl = np.asarray(gt_labels).reshape(-1)
+        matched = np.zeros(len(gtb), dtype=bool)
+        order = np.argsort(-det[:, 1]) if len(det) else []
+        for i in order:
+            lab, score = det[i, 0], det[i, 1]
+            if lab < 0:
+                continue
+            box = det[i, 2:6]
+            best_iou, best_j = 0.0, -1
+            for j, (gb, gl) in enumerate(zip(gtb, gtl)):
+                if gl != lab or matched[j]:
+                    continue
+                xi = max(box[0], gb[0])
+                yi = max(box[1], gb[1])
+                xa = min(box[2], gb[2])
+                ya = min(box[3], gb[3])
+                inter = max(xa - xi, 0) * max(ya - yi, 0)
+                a1 = max(box[2] - box[0], 0) * max(box[3] - box[1], 0)
+                a2 = max(gb[2] - gb[0], 0) * max(gb[3] - gb[1], 0)
+                iou = inter / max(a1 + a2 - inter, 1e-10)
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            tp = best_iou >= self._overlap and best_j >= 0
+            if tp:
+                matched[best_j] = True
+            self._records.append((int(lab), float(score), bool(tp),
+                                  len(gtl)))
+
+    def eval(self):
+        if not self._records:
+            return 0.0
+        labels = sorted({r[0] for r in self._records})
+        aps = []
+        for lab in labels:
+            rec = sorted([r for r in self._records if r[0] == lab],
+                         key=lambda r: -r[1])
+            npos = sum(r[3] for r in self._records if r[0] == lab) or 1
+            tp_cum = np.cumsum([1.0 if r[2] else 0.0 for r in rec])
+            fp_cum = np.cumsum([0.0 if r[2] else 1.0 for r in rec])
+            recall = tp_cum / npos
+            precision = tp_cum / np.maximum(tp_cum + fp_cum, 1e-10)
+            ap = 0.0
+            prev_r = 0.0
+            for p, r in zip(precision, recall):
+                ap += p * (r - prev_r)
+                prev_r = r
+            aps.append(ap)
+        return float(np.mean(aps))

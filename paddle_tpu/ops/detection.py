@@ -1,0 +1,423 @@
+"""Detection ops (SSD / YOLO / RCNN family).
+
+Parity: reference paddle/fluid/operators/detection/.  Batched, fixed-shape
+formulations (XLA-friendly): variable-count outputs (NMS survivors, proposal
+lists) are returned fixed-size with validity masks/scores rather than ragged
+LoD outputs.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('iou_similarity')
+def iou_similarity(ctx, ins, attrs):
+    x, y = ins['X'], ins['Y']  # [N,4], [M,4] xyxy
+
+    def area(b):
+        return jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+            jnp.maximum(b[..., 3] - b[..., 1], 0)
+    xi = jnp.maximum(x[:, None, 0], y[None, :, 0])
+    yi = jnp.maximum(x[:, None, 1], y[None, :, 1])
+    xa = jnp.minimum(x[:, None, 2], y[None, :, 2])
+    ya = jnp.minimum(x[:, None, 3], y[None, :, 3])
+    inter = jnp.maximum(xa - xi, 0) * jnp.maximum(ya - yi, 0)
+    union = area(x)[:, None] + area(y)[None, :] - inter
+    return {'Out': inter / jnp.maximum(union, 1e-10)}
+
+
+@register('box_coder')
+def box_coder(ctx, ins, attrs):
+    prior, tb = ins['PriorBox'], ins['TargetBox']
+    pvar = ins.get('PriorBoxVar')
+    code_type = attrs.get('code_type', 'encode_center_size')
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if pvar is None:
+        pvar = jnp.ones((prior.shape[0], 4), prior.dtype)
+    if code_type.startswith('encode'):
+        tw = tb[:, None, 2] - tb[:, None, 0]
+        th = tb[:, None, 3] - tb[:, None, 1]
+        tcx = tb[:, None, 0] + 0.5 * tw
+        tcy = tb[:, None, 1] + 0.5 * th
+        out = jnp.stack([
+            (tcx - pcx[None]) / pw[None] / pvar[None, :, 0],
+            (tcy - pcy[None]) / ph[None] / pvar[None, :, 1],
+            jnp.log(jnp.maximum(tw / pw[None], 1e-10)) / pvar[None, :, 2],
+            jnp.log(jnp.maximum(th / ph[None], 1e-10)) / pvar[None, :, 3],
+        ], axis=-1)
+    else:
+        # decode: tb [N, M, 4] deltas
+        dcx = tb[..., 0] * pvar[None, :, 0] * pw[None] + pcx[None]
+        dcy = tb[..., 1] * pvar[None, :, 1] * ph[None] + pcy[None]
+        dw = jnp.exp(tb[..., 2] * pvar[None, :, 2]) * pw[None]
+        dh = jnp.exp(tb[..., 3] * pvar[None, :, 3]) * ph[None]
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2, dcy + dh / 2], axis=-1)
+    return {'OutputBox': out}
+
+
+@register('prior_box')
+def prior_box(ctx, ins, attrs):
+    feat, image = ins['Input'], ins['Image']  # NCHW
+    min_sizes = attrs['min_sizes']
+    max_sizes = attrs.get('max_sizes', [])
+    ars_attr = attrs.get('aspect_ratios', [1.0])
+    flip = attrs.get('flip', False)
+    step_w = attrs.get('step_w', 0.0)
+    step_h = attrs.get('step_h', 0.0)
+    offset = attrs.get('offset', 0.5)
+    clip = attrs.get('clip', False)
+    variances = attrs.get('variances', [0.1, 0.1, 0.2, 0.2])
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw = step_w or img_w / W
+    sh = step_h or img_h / H
+    ars = [1.0]
+    for a in ars_attr:
+        if abs(a - 1.0) > 1e-6:
+            ars.append(a)
+            if flip:
+                ars.append(1.0 / a)
+    boxes = []
+    for ms in min_sizes:
+        for a in ars:
+            boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+        if max_sizes:
+            pass
+    for ms, mxs in zip(min_sizes, max_sizes or []):
+        boxes.append((np.sqrt(ms * mxs), np.sqrt(ms * mxs)))
+    nprior = len(boxes)
+    cx = (jnp.arange(W) + offset) * sw
+    cy = (jnp.arange(H) + offset) * sh
+    gy, gx = jnp.meshgrid(cy, cx, indexing='ij')
+    whs = jnp.asarray(boxes)  # [P, 2]
+    out = jnp.stack([
+        (gx[..., None] - whs[None, None, :, 0] / 2) / img_w,
+        (gy[..., None] - whs[None, None, :, 1] / 2) / img_h,
+        (gx[..., None] + whs[None, None, :, 0] / 2) / img_w,
+        (gy[..., None] + whs[None, None, :, 1] / 2) / img_h,
+    ], axis=-1)  # [H, W, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {'Boxes': out, 'Variances': var}
+
+
+@register('density_prior_box')
+def density_prior_box(ctx, ins, attrs):
+    feat, image = ins['Input'], ins['Image']
+    fixed_sizes = attrs.get('fixed_sizes', [])
+    fixed_ratios = attrs.get('fixed_ratios', [])
+    densities = attrs.get('densities', [])
+    offset = attrs.get('offset', 0.5)
+    variances = attrs.get('variances', [0.1, 0.1, 0.2, 0.2])
+    H, W = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw, sh = img_w / W, img_h / H
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            step = size / density
+            for di in range(density):
+                for dj in range(density):
+                    boxes.append((bw, bh,
+                                  -size / 2 + step / 2 + dj * step,
+                                  -size / 2 + step / 2 + di * step))
+    cx = (jnp.arange(W) + offset) * sw
+    cy = (jnp.arange(H) + offset) * sh
+    gy, gx = jnp.meshgrid(cy, cx, indexing='ij')
+    arr = jnp.asarray(boxes)  # [P, 4] = bw, bh, ox, oy
+    ctrx = gx[..., None] + arr[None, None, :, 2]
+    ctry = gy[..., None] + arr[None, None, :, 3]
+    out = jnp.stack([
+        (ctrx - arr[None, None, :, 0] / 2) / img_w,
+        (ctry - arr[None, None, :, 1] / 2) / img_h,
+        (ctrx + arr[None, None, :, 0] / 2) / img_w,
+        (ctry + arr[None, None, :, 1] / 2) / img_h,
+    ], axis=-1)
+    out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {'Boxes': out, 'Variances': var}
+
+
+@register('anchor_generator')
+def anchor_generator(ctx, ins, attrs):
+    feat = ins['Input']
+    anchor_sizes = attrs['anchor_sizes']
+    ars = attrs['aspect_ratios']
+    stride = attrs['stride']
+    offset = attrs.get('offset', 0.5)
+    variances = attrs.get('variances', [0.1, 0.1, 0.2, 0.2])
+    H, W = feat.shape[2], feat.shape[3]
+    whs = []
+    for s in anchor_sizes:
+        for a in ars:
+            whs.append((s * np.sqrt(a), s / np.sqrt(a)))
+    cx = (jnp.arange(W) + offset) * stride[0]
+    cy = (jnp.arange(H) + offset) * stride[1]
+    gy, gx = jnp.meshgrid(cy, cx, indexing='ij')
+    arr = jnp.asarray(whs)
+    out = jnp.stack([
+        gx[..., None] - arr[None, None, :, 0] / 2,
+        gy[..., None] - arr[None, None, :, 1] / 2,
+        gx[..., None] + arr[None, None, :, 0] / 2,
+        gy[..., None] + arr[None, None, :, 1] / 2,
+    ], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {'Anchors': out, 'Variances': var}
+
+
+@register('yolov3_loss')
+def yolov3_loss(ctx, ins, attrs):
+    x = ins['X']  # [N, C, H, W]
+    gt_box = ins['GTBox']  # [N, B, 4] cx cy w h (normalized)
+    gt_label = ins['GTLabel']  # [N, B]
+    anchors = attrs['anchors']
+    anchor_mask = attrs.get('anchor_mask', list(range(len(anchors) // 2)))
+    class_num = attrs['class_num']
+    ignore_thresh = attrs.get('ignore_thresh', 0.7)
+    downsample = attrs.get('downsample_ratio', 32)
+    N, C, H, W = x.shape
+    na = len(anchor_mask)
+    an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    amask = jnp.asarray(anchor_mask)
+    pred = x.reshape(N, na, 5 + class_num, H, W)
+    px = jax.nn.sigmoid(pred[:, :, 0])
+    py = jax.nn.sigmoid(pred[:, :, 1])
+    pw, ph = pred[:, :, 2], pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]
+    input_size = downsample * H
+    # build targets: for each gt, responsible cell + best anchor
+    gtx, gty = gt_box[..., 0], gt_box[..., 1]
+    gtw, gth = gt_box[..., 2], gt_box[..., 3]
+    gi = jnp.clip((gtx * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gty * H).astype(jnp.int32), 0, H - 1)
+    valid = (gtw > 0)
+    # best anchor by IoU of (w, h)
+    aw = an[:, 0] / input_size
+    ah = an[:, 1] / input_size
+    inter = jnp.minimum(gtw[..., None], aw) * jnp.minimum(gth[..., None], ah)
+    union = gtw[..., None] * gth[..., None] + aw * ah - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N, B]
+    in_mask = jnp.any(best[..., None] == amask, axis=-1) & valid
+    tx = gtx * W - gi
+    ty = gty * H - gj
+    local_a = jnp.argmax(best[..., None] == amask, axis=-1)
+    sel_aw = jnp.take(aw, best)
+    sel_ah = jnp.take(ah, best)
+    tw = jnp.log(jnp.maximum(gtw / jnp.maximum(sel_aw, 1e-10), 1e-10))
+    th = jnp.log(jnp.maximum(gth / jnp.maximum(sel_ah, 1e-10), 1e-10))
+    scale = 2.0 - gtw * gth
+    bidx = jnp.arange(N)[:, None]
+
+    def gather_pred(p):
+        return p[bidx, local_a, gj, gi]
+    mf = in_mask.astype(x.dtype)
+    loss_xy = jnp.sum(mf * scale * (
+        jnp.square(gather_pred(px) - tx) + jnp.square(gather_pred(py) - ty)),
+        axis=1)
+    loss_wh = jnp.sum(mf * scale * (
+        jnp.square(gather_pred(pw) - tw) + jnp.square(gather_pred(ph) - th)),
+        axis=1)
+    obj_target = jnp.zeros((N, na, H, W)).at[bidx, local_a, gj, gi].max(mf)
+    bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(
+        jnp.exp(-jnp.abs(z)))
+    loss_obj = jnp.sum(bce(pobj, obj_target), axis=(1, 2, 3))
+    cls_t = jax.nn.one_hot(gt_label, class_num, dtype=x.dtype)
+    pc = pcls[bidx, local_a, :, gj, gi]
+    loss_cls = jnp.sum(mf[..., None] * bce(pc, cls_t), axis=(1, 2))
+    return {'Loss': loss_xy + loss_wh + loss_obj + loss_cls}
+
+
+@register('polygon_box_transform')
+def polygon_box_transform(ctx, ins, attrs):
+    x = ins['Input']  # [N, geo, H, W]
+    n, g, h, w = x.shape
+    gy, gx = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing='ij')
+    out = x.at[:, 0::2].set(gx[None, None] * 4.0 - x[:, 0::2])
+    out = out.at[:, 1::2].set(gy[None, None] * 4.0 - out[:, 1::2])
+    return {'Output': out}
+
+
+def _nms_fixed(boxes, scores, iou_thresh, max_out):
+    """Fixed-size NMS via iterative suppression (lax.fori-friendly)."""
+    def body(i, state):
+        sc, keep = state
+        best = jnp.argmax(sc)
+        keep = keep.at[i].set(best)
+        bb = boxes[best]
+        xi = jnp.maximum(boxes[:, 0], bb[0])
+        yi = jnp.maximum(boxes[:, 1], bb[1])
+        xa = jnp.minimum(boxes[:, 2], bb[2])
+        ya = jnp.minimum(boxes[:, 3], bb[3])
+        inter = jnp.maximum(xa - xi, 0) * jnp.maximum(ya - yi, 0)
+        area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+            jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+        ab = jnp.maximum(bb[2] - bb[0], 0) * jnp.maximum(bb[3] - bb[1], 0)
+        iou = inter / jnp.maximum(area + ab - inter, 1e-10)
+        sc = jnp.where(iou > iou_thresh, -jnp.inf, sc)
+        sc = sc.at[best].set(-jnp.inf)
+        return sc, keep
+    keep0 = jnp.zeros((max_out,), jnp.int32)
+    _, keep = jax.lax.fori_loop(0, max_out, body, (scores, keep0))
+    return keep
+
+
+@register('multiclass_nms')
+def multiclass_nms(ctx, ins, attrs):
+    """Detection output with per-class NMS; fixed-size [N, keep, 6] output
+    (label, score, x1, y1, x2, y2), invalid rows get label -1."""
+    bboxes, scores = ins['BBoxes'], ins['Scores']
+    # bboxes [N, M, 4]; scores [N, C, M]
+    score_thresh = attrs.get('score_threshold', 0.01)
+    nms_thresh = attrs.get('nms_threshold', 0.3)
+    keep_top_k = attrs.get('keep_top_k', 100)
+    if keep_top_k <= 0:
+        keep_top_k = 100
+    N, C, M = scores.shape
+
+    def per_image(box, sc):
+        outs = []
+        for c in range(C):
+            s = jnp.where(sc[c] >= score_thresh, sc[c], -jnp.inf)
+            k = min(keep_top_k, M)
+            keep = _nms_fixed(box, s, nms_thresh, k)
+            kept_s = jnp.take(s, keep)
+            kept_b = jnp.take(box, keep, axis=0)
+            lab = jnp.where(jnp.isfinite(kept_s), float(c), -1.0)
+            outs.append(jnp.concatenate(
+                [lab[:, None], jnp.where(jnp.isfinite(kept_s), kept_s,
+                                         0.0)[:, None], kept_b], axis=1))
+        allc = jnp.concatenate(outs, axis=0)
+        order = jnp.argsort(-allc[:, 1])
+        return jnp.take(allc, order[:keep_top_k], axis=0)
+
+    out = jax.vmap(per_image)(bboxes, scores)
+    return {'Out': out}
+
+
+@register('bipartite_match')
+def bipartite_match(ctx, ins, attrs):
+    dist = ins['DistMat']  # [N, M] (rows: gt? cols: priors)
+    # greedy bipartite matching like the reference's default
+    n, m = dist.shape
+
+    def body(i, state):
+        d, row_to_col, col_matched = state
+        flat = jnp.argmax(d)
+        r, c = flat // m, flat % m
+        ok = d[r, c] > -jnp.inf
+        row_to_col = jnp.where(ok, row_to_col.at[r].set(c), row_to_col)
+        col_matched = jnp.where(ok, col_matched.at[c].set(r), col_matched)
+        d = d.at[r, :].set(-jnp.inf)
+        d = d.at[:, c].set(-jnp.inf)
+        return d, row_to_col, col_matched
+
+    init = (dist, -jnp.ones((n,), jnp.int32), -jnp.ones((m,), jnp.int32))
+    _, row_to_col, col_match = jax.lax.fori_loop(0, min(n, m), body, init)
+    dist_out = jnp.where(col_match >= 0,
+                         dist[jnp.maximum(col_match, 0),
+                              jnp.arange(m)], 0.0)
+    return {'ColToRowMatchIndices': col_match[None, :],
+            'ColToRowMatchDist': dist_out[None, :]}
+
+
+@register('target_assign')
+def target_assign(ctx, ins, attrs):
+    x, match = ins['X'], ins['MatchIndices']  # x [M', K], match [N, P]
+    mismatch_value = attrs.get('mismatch_value', 0)
+    idx = jnp.maximum(match, 0)
+    out = jnp.take(x, idx, axis=0)  # [N, P, K]
+    w = (match >= 0).astype(jnp.float32)
+    out = jnp.where(match[..., None] >= 0, out, mismatch_value)
+    return {'Out': out, 'OutWeight': w[..., None]}
+
+
+@register('roi_align')
+def roi_align(ctx, ins, attrs):
+    x, rois = ins['X'], ins['ROIs']  # x NCHW, rois [R, 4] + RoisBatch
+    ph = attrs.get('pooled_height', 1)
+    pw = attrs.get('pooled_width', 1)
+    scale = attrs.get('spatial_scale', 1.0)
+    ratio = attrs.get('sampling_ratio', -1)
+    ratio = 2 if ratio <= 0 else ratio
+    batch_idx = ins.get('RoisBatch')
+    R = rois.shape[0]
+    if batch_idx is None:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample ratio x ratio points per bin, bilinear
+        py = y1 + (jnp.arange(ph * ratio) + 0.5) * bin_h / ratio
+        px = x1 + (jnp.arange(pw * ratio) + 0.5) * bin_w / ratio
+        py = jnp.clip(py, 0, h - 1)
+        px = jnp.clip(px, 0, w - 1)
+        y0 = jnp.floor(py).astype(jnp.int32)
+        x0 = jnp.floor(px).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = py - y0
+        wx = px - x0
+        img = x[bi]  # [C, H, W]
+        v = (img[:, y0][:, :, x0] * ((1 - wy)[:, None] * (1 - wx)[None, :])[None] +
+             img[:, y1i][:, :, x0] * (wy[:, None] * (1 - wx)[None, :])[None] +
+             img[:, y0][:, :, x1i] * ((1 - wy)[:, None] * wx[None, :])[None] +
+             img[:, y1i][:, :, x1i] * (wy[:, None] * wx[None, :])[None])
+        v = v.reshape(c, ph, ratio, pw, ratio).mean(axis=(2, 4))
+        return v
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {'Out': out}
+
+
+@register('roi_pool')
+def roi_pool(ctx, ins, attrs):
+    x, rois = ins['X'], ins['ROIs']
+    ph = attrs.get('pooled_height', 1)
+    pw = attrs.get('pooled_width', 1)
+    scale = attrs.get('spatial_scale', 1.0)
+    batch_idx = ins.get('RoisBatch')
+    R = rois.shape[0]
+    if batch_idx is None:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        img = x[bi]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        out = jnp.full((c, ph, pw), -jnp.inf, x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                ys_lo = y1 + (i * rh) // ph
+                ys_hi = y1 + ((i + 1) * rh + ph - 1) // ph
+                xs_lo = x1 + (j * rw) // pw
+                xs_hi = x1 + ((j + 1) * rw + pw - 1) // pw
+                m = ((ys >= ys_lo) & (ys < jnp.maximum(ys_hi, ys_lo + 1)))[:, None] & \
+                    ((xs >= xs_lo) & (xs < jnp.maximum(xs_hi, xs_lo + 1)))[None, :]
+                out = out.at[:, i, j].set(
+                    jnp.max(jnp.where(m[None], img, -jnp.inf), axis=(1, 2)))
+        return out
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {'Out': jnp.where(jnp.isfinite(out), out, 0.0), 'Argmax': None}

@@ -1,0 +1,394 @@
+"""Elementwise / activation / reduction / linear-algebra ops.
+
+Parity: reference paddle/fluid/operators/elementwise/*, activation_op.*,
+reduce_op.*, matmul_op.*, mul_op.*, scale_op.*, cast_op.*, etc.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+# --------------------------------------------------------------- helpers
+
+def _bcast_y(x, y, axis):
+    """Fluid elementwise broadcast: y's shape must be a contiguous
+    subsequence of x's; `axis` is where it aligns (-1 = align trailing).
+    Reference: operators/elementwise/elementwise_op_function.h."""
+    if x.shape == y.shape:
+        return y
+    if y.ndim == 0:
+        return y
+    ax = axis if axis >= 0 else x.ndim - y.ndim
+    # trim trailing 1s of y (fluid allows y shape [N, 1])
+    yshape = list(y.shape)
+    while len(yshape) > 1 and yshape[-1] == 1 and \
+            ax + len(yshape) > x.ndim:
+        yshape = yshape[:-1]
+    new_shape = [1] * ax + yshape + [1] * (x.ndim - ax - len(yshape))
+    return y.reshape(new_shape)
+
+
+def _ew(name, fn):
+    @register(name)
+    def impl(ctx, ins, attrs, fn=fn):
+        x, y = ins['X'], ins['Y']
+        y = _bcast_y(x, y, attrs.get('axis', -1))
+        return {'Out': fn(x, y)}
+    return impl
+
+
+_ew('elementwise_add', lambda x, y: x + y)
+_ew('elementwise_sub', lambda x, y: x - y)
+_ew('elementwise_mul', lambda x, y: x * y)
+_ew('elementwise_div', lambda x, y: x / y)
+_ew('elementwise_max', jnp.maximum)
+_ew('elementwise_min', jnp.minimum)
+_ew('elementwise_pow', jnp.power)
+_ew('elementwise_mod', jnp.mod)
+_ew('elementwise_floordiv', jnp.floor_divide)
+
+
+def _cmp(name, fn):
+    @register(name)
+    def impl(ctx, ins, attrs, fn=fn):
+        x, y = ins['X'], ins['Y']
+        y = _bcast_y(x, y, attrs.get('axis', -1))
+        return {'Out': fn(x, y)}
+
+
+_cmp('less_than', lambda x, y: x < y)
+_cmp('less_equal', lambda x, y: x <= y)
+_cmp('greater_than', lambda x, y: x > y)
+_cmp('greater_equal', lambda x, y: x >= y)
+_cmp('equal', lambda x, y: x == y)
+_cmp('not_equal', lambda x, y: x != y)
+
+
+def _logical(name, fn, binary=True):
+    @register(name)
+    def impl(ctx, ins, attrs, fn=fn, binary=binary):
+        if binary:
+            return {'Out': fn(ins['X'], ins['Y'])}
+        return {'Out': fn(ins['X'])}
+
+
+_logical('logical_and', jnp.logical_and)
+_logical('logical_or', jnp.logical_or)
+_logical('logical_xor', jnp.logical_xor)
+_logical('logical_not', jnp.logical_not, binary=False)
+
+
+# --------------------------------------------------------------- unary
+
+def _unary(name, fn):
+    @register(name)
+    def impl(ctx, ins, attrs, fn=fn):
+        return {'Out': fn(ins['X'])}
+    return impl
+
+
+_unary('sigmoid', jax.nn.sigmoid)
+_unary('logsigmoid', jax.nn.log_sigmoid)
+_unary('tanh', jnp.tanh)
+_unary('tanh_shrink', lambda x: x - jnp.tanh(x))
+_unary('exp', jnp.exp)
+_unary('log', jnp.log)
+_unary('sqrt', jnp.sqrt)
+_unary('rsqrt', lax.rsqrt)
+_unary('abs', jnp.abs)
+_unary('ceil', jnp.ceil)
+_unary('floor', jnp.floor)
+_unary('cos', jnp.cos)
+_unary('sin', jnp.sin)
+_unary('round', jnp.round)
+_unary('reciprocal', jnp.reciprocal)
+_unary('square', jnp.square)
+_unary('softplus', jax.nn.softplus)
+_unary('softsign', jax.nn.soft_sign)
+_unary('relu', jax.nn.relu)
+_unary('sign', jnp.sign)
+_unary('erf', lax.erf)
+
+
+@register('relu6')
+def relu6(ctx, ins, attrs):
+    t = attrs.get('threshold', 6.0)
+    return {'Out': jnp.clip(ins['X'], 0.0, t)}
+
+
+@register('leaky_relu')
+def leaky_relu(ctx, ins, attrs):
+    a = attrs.get('alpha', 0.02)
+    x = ins['X']
+    return {'Out': jnp.where(x >= 0, x, a * x)}
+
+
+@register('elu')
+def elu(ctx, ins, attrs):
+    a = attrs.get('alpha', 1.0)
+    x = ins['X']
+    return {'Out': jnp.where(x >= 0, x, a * (jnp.exp(x) - 1.0))}
+
+
+@register('selu')
+def selu(ctx, ins, attrs):
+    scale = attrs.get('scale', 1.0507009873554805)
+    alpha = attrs.get('alpha', 1.6732632423543772)
+    x = ins['X']
+    return {'Out': scale * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))}
+
+
+@register('brelu')
+def brelu(ctx, ins, attrs):
+    return {'Out': jnp.clip(ins['X'], attrs.get('t_min', 0.0),
+                            attrs.get('t_max', 24.0))}
+
+
+@register('soft_relu')
+def soft_relu(ctx, ins, attrs):
+    t = attrs.get('threshold', 40.0)
+    x = jnp.clip(ins['X'], -t, t)
+    return {'Out': jnp.log1p(jnp.exp(x))}
+
+
+@register('hard_sigmoid')
+def hard_sigmoid(ctx, ins, attrs):
+    slope = attrs.get('slope', 0.2)
+    offset = attrs.get('offset', 0.5)
+    return {'Out': jnp.clip(slope * ins['X'] + offset, 0.0, 1.0)}
+
+
+@register('swish')
+def swish(ctx, ins, attrs):
+    beta = attrs.get('beta', 1.0)
+    x = ins['X']
+    return {'Out': x * jax.nn.sigmoid(beta * x)}
+
+
+@register('stanh')
+def stanh(ctx, ins, attrs):
+    a = attrs.get('scale_a', 2.0 / 3.0)
+    b = attrs.get('scale_b', 1.7159)
+    return {'Out': b * jnp.tanh(a * ins['X'])}
+
+
+@register('pow')
+def pow_op(ctx, ins, attrs):
+    return {'Out': jnp.power(ins['X'], attrs.get('factor', 1.0))}
+
+
+@register('thresholded_relu')
+def thresholded_relu(ctx, ins, attrs):
+    t = attrs.get('threshold', 1.0)
+    x = ins['X']
+    return {'Out': jnp.where(x > t, x, 0.0)}
+
+
+@register('hard_shrink')
+def hard_shrink(ctx, ins, attrs):
+    t = attrs.get('threshold', 0.5)
+    x = ins['X']
+    return {'Out': jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register('softshrink')
+def softshrink(ctx, ins, attrs):
+    lam = attrs.get('lambda', 0.5)
+    x = ins['X']
+    return {'Out': jnp.where(x > lam, x - lam,
+                             jnp.where(x < -lam, x + lam, 0.0))}
+
+
+@register('prelu')
+def prelu(ctx, ins, attrs):
+    x, alpha = ins['X'], ins['Alpha']
+    mode = attrs.get('mode', 'all')
+    if mode == 'channel':
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == 'all':
+        alpha = alpha.reshape((1,) * x.ndim)
+    return {'Out': jnp.where(x >= 0, x, alpha * x)}
+
+
+@register('scale')
+def scale(ctx, ins, attrs):
+    s = attrs.get('scale', 1.0)
+    b = attrs.get('bias', 0.0)
+    x = ins['X']
+    if attrs.get('bias_after_scale', True):
+        return {'Out': x * s + jnp.asarray(b, x.dtype)}
+    return {'Out': (x + jnp.asarray(b, x.dtype)) * s}
+
+
+@register('clip')
+def clip(ctx, ins, attrs):
+    return {'Out': jnp.clip(ins['X'], attrs['min'], attrs['max'])}
+
+
+@register('clip_by_norm')
+def clip_by_norm(ctx, ins, attrs):
+    x = ins['X']
+    max_norm = attrs['max_norm']
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {'Out': x * scale}
+
+
+@register('cast')
+def cast(ctx, ins, attrs):
+    from ..core.dtypes import convert_dtype
+    return {'Out': ins['X'].astype(convert_dtype(attrs['out_dtype']))}
+
+
+@register('cumsum')
+def cumsum(ctx, ins, attrs):
+    x = ins['X']
+    axis = attrs.get('axis', -1)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get('exclusive', False):
+        out = out - x
+    if attrs.get('reverse', False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get('exclusive', False):
+            out = out - x
+    return {'Out': out}
+
+
+# --------------------------------------------------------------- reduce
+
+def _reduce(name, fn):
+    @register(name)
+    def impl(ctx, ins, attrs, fn=fn):
+        x = ins['X']
+        dim = attrs.get('dim', [0])
+        keep = attrs.get('keep_dim', False)
+        if attrs.get('reduce_all', False):
+            out = fn(x, axis=None, keepdims=keep)
+        else:
+            dim = [dim] if isinstance(dim, int) else list(dim)
+            dim = tuple(d % x.ndim for d in dim)
+            out = fn(x, axis=dim, keepdims=keep)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return {'Out': out}
+
+
+_reduce('reduce_sum', jnp.sum)
+_reduce('reduce_mean', jnp.mean)
+_reduce('reduce_max', jnp.max)
+_reduce('reduce_min', jnp.min)
+_reduce('reduce_prod', jnp.prod)
+_reduce('reduce_all', jnp.all)
+_reduce('reduce_any', jnp.any)
+
+
+@register('mean')
+def mean(ctx, ins, attrs):
+    # reference mean_op: full reduction, output shape [1]
+    return {'Out': jnp.mean(ins['X']).reshape(1)}
+
+
+@register('sum')
+def sum_op(ctx, ins, attrs):
+    xs = ins['X']
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {'Out': out}
+
+
+# --------------------------------------------------------------- matmul
+
+@register('matmul')
+def matmul(ctx, ins, attrs):
+    x, y = ins['X'], ins['Y']
+    tx, ty = attrs.get('transpose_X', False), attrs.get('transpose_Y', False)
+    alpha = attrs.get('alpha', 1.0)
+    if x.ndim == 1:
+        x = x.reshape(1, -1)
+    if y.ndim == 1:
+        y = y.reshape(-1, 1)
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {'Out': out}
+
+
+@register('mul')
+def mul(ctx, ins, attrs):
+    # reference mul_op: flatten both sides to 2-D then GEMM (maps straight
+    # onto the MXU)
+    x, y = ins['X'], ins['Y']
+    xn = attrs.get('x_num_col_dims', 1)
+    yn = attrs.get('y_num_col_dims', 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(int(np.prod(xs[:xn])), -1)
+    y2 = y.reshape(int(np.prod(ys[:yn])), -1)
+    out = x2 @ y2
+    return {'Out': out.reshape(xs[:xn] + ys[yn:])}
+
+
+@register('bilinear_tensor_product')
+def bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = ins['X'], ins['Y'], ins['Weight']
+    # w: [out_dim, dx, dy]
+    out = jnp.einsum('bi,oij,bj->bo', x, w, y)
+    if 'Bias' in ins:
+        out = out + ins['Bias']
+    return {'Out': out}
+
+
+@register('cos_sim')
+def cos_sim(ctx, ins, attrs):
+    x, y = ins['X'], ins['Y']
+    xn = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / (xn * yn + 1e-12)
+    return {'Out': out, 'XNorm': xn, 'YNorm': yn}
+
+
+@register('l2_normalize')
+def l2_normalize(ctx, ins, attrs):
+    x = ins['X']
+    axis = attrs.get('axis', -1)
+    eps = attrs.get('epsilon', 1e-12)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    norm = jnp.maximum(norm, eps)
+    return {'Out': x / norm, 'Norm': norm}
+
+
+@register('increment')
+def increment(ctx, ins, attrs):
+    x = ins['X']
+    return {'Out': x + jnp.asarray(attrs.get('step', 1.0), x.dtype)}
+
+
+@register('isfinite')
+def isfinite(ctx, ins, attrs):
+    return {'Out': jnp.all(jnp.isfinite(ins['X'])).reshape(1)}
+
+
+@register('has_inf')
+def has_inf(ctx, ins, attrs):
+    return {'Out': jnp.any(jnp.isinf(ins['X'])).reshape(1)}
+
+
+@register('has_nan')
+def has_nan(ctx, ins, attrs):
+    return {'Out': jnp.any(jnp.isnan(ins['X'])).reshape(1)}
+
+
+@register('maxout')
+def maxout(ctx, ins, attrs):
+    x = ins['X']  # NCHW
+    g = attrs['groups']
+    n, c, h, w = x.shape
+    return {'Out': x.reshape(n, c // g, g, h, w).max(axis=2)}

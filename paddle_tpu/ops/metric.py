@@ -1,0 +1,74 @@
+"""Metric ops: accuracy, auc, mean_iou, edit distance (batch-local parts).
+
+Parity: reference accuracy_op, auc_op, mean_iou_op, precision_recall.
+Streaming state (AUC stat buckets etc.) lives in persistable vars updated by
+the op, same pattern as the reference.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+
+
+@register('accuracy')
+def accuracy(ctx, ins, attrs):
+    indices, label = ins['Indices'], ins['Label']
+    if label.ndim == 2 and label.shape[1] == 1:
+        label = label[:, 0]
+    correct = jnp.any(indices == label[:, None], axis=1)
+    total = jnp.asarray(label.shape[0], jnp.int32)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    acc = (num_correct.astype(jnp.float32) / total.astype(jnp.float32))
+    return {'Accuracy': acc.reshape(1), 'Correct': num_correct.reshape(1),
+            'Total': total.reshape(1)}
+
+
+@register('auc')
+def auc(ctx, ins, attrs):
+    """Streaming AUC with histogram buckets (ref auc_op.cc)."""
+    preds, label = ins['Predict'], ins['Label']
+    stat_pos, stat_neg = ins['StatPos'], ins['StatNeg']
+    num_thresholds = attrs.get('num_thresholds', 4095)
+    if label.ndim == 2:
+        label = label[:, 0]
+    p1 = preds[:, -1] if preds.ndim == 2 else preds
+    bucket = jnp.clip((p1 * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    pos = (label > 0).astype(stat_pos.dtype)
+    new_pos = stat_pos.at[bucket].add(pos)
+    new_neg = stat_neg.at[bucket].add(1 - pos)
+    # trapezoid integration over thresholds, descending
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    total_pos = tp[-1]
+    total_neg = fp[-1]
+    tpr = tp / jnp.maximum(total_pos, 1)
+    fpr = fp / jnp.maximum(total_neg, 1)
+    auc_val = jnp.trapezoid(tpr, fpr)
+    return {'AUC': auc_val.reshape(1).astype(jnp.float64)
+            if False else auc_val.reshape(1),
+            'StatPosOut': new_pos, 'StatNegOut': new_neg}
+
+
+@register('mean_iou')
+def mean_iou(ctx, ins, attrs):
+    pred, label = ins['Predictions'], ins['Labels']
+    num_classes = attrs['num_classes']
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    idx = l * num_classes + p
+    cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx].add(1.0)
+    cm = cm.reshape(num_classes, num_classes)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-12), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {'OutMeanIou': miou.reshape(1),
+            'OutWrong': jnp.sum(cm, 1) - inter,
+            'OutCorrect': inter}
+
+
+@register('precision_recall')
+def precision_recall(ctx, ins, attrs):
+    raise NotImplementedError('use paddle_tpu.metrics.Precision/Recall')

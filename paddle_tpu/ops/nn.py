@@ -1,0 +1,425 @@
+"""NN ops: conv / pool / norm / softmax / dropout / resize.
+
+Parity: reference conv_op, pool_op, batch_norm_op, layer_norm_op,
+group_norm_op, softmax_op, dropout_op, lrn_op, interpolate_op, etc.
+Convs/pools use lax.conv_general_dilated / lax.reduce_window in NCHW — XLA
+lays them out for the MXU; no cuDNN-style algo selection needed.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+from ..core.dtypes import convert_dtype
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register('conv2d')
+def conv2d(ctx, ins, attrs):
+    x, w = ins['Input'], ins['Filter']
+    strides = _pair(attrs.get('strides', [1, 1]))
+    pads = _pair(attrs.get('paddings', [0, 0]))
+    dil = _pair(attrs.get('dilations', [1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    if 'Bias' in ins:
+        out = out + ins['Bias'].reshape(1, -1, 1, 1)
+    return {'Output': out}
+
+
+@register('conv3d')
+def conv3d(ctx, ins, attrs):
+    x, w = ins['Input'], ins['Filter']
+    strides = _pair(attrs.get('strides', [1, 1, 1]), 3)
+    pads = _pair(attrs.get('paddings', [0, 0, 0]), 3)
+    dil = _pair(attrs.get('dilations', [1, 1, 1]), 3)
+    groups = attrs.get('groups', 1) or 1
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    return {'Output': out}
+
+
+@register('conv2d_transpose')
+def conv2d_transpose(ctx, ins, attrs):
+    x, w = ins['Input'], ins['Filter']  # w: [in_c, out_c/groups, kh, kw]
+    strides = _pair(attrs.get('strides', [1, 1]))
+    pads = _pair(attrs.get('paddings', [0, 0]))
+    dil = _pair(attrs.get('dilations', [1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    kh, kw = w.shape[2], w.shape[3]
+    # gradient-of-conv formulation: lhs_dilation = stride
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3)).swapaxes(0, 1) if groups == 1 else w,
+        window_strides=(1, 1),
+        padding=[(dil[0] * (kh - 1) - pads[0], dil[0] * (kh - 1) - pads[0]),
+                 (dil[1] * (kw - 1) - pads[1], dil[1] * (kw - 1) - pads[1])],
+        lhs_dilation=strides, rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    return {'Output': out}
+
+
+@register('conv3d_transpose')
+def conv3d_transpose(ctx, ins, attrs):
+    x, w = ins['Input'], ins['Filter']
+    strides = _pair(attrs.get('strides', [1, 1, 1]), 3)
+    pads = _pair(attrs.get('paddings', [0, 0, 0]), 3)
+    dil = _pair(attrs.get('dilations', [1, 1, 1]), 3)
+    ks = w.shape[2:]
+    out = lax.conv_general_dilated(
+        x, jnp.flip(w, (2, 3, 4)).swapaxes(0, 1),
+        window_strides=(1, 1, 1),
+        padding=[(dil[i] * (ks[i] - 1) - pads[i],) * 2 for i in range(3)],
+        lhs_dilation=strides, rhs_dilation=dil,
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    return {'Output': out}
+
+
+def _pool(x, ksize, strides, pads, ptype, exclusive, ceil_mode,
+          global_pool, adaptive=False, nd=2):
+    if global_pool:
+        axes = tuple(range(2, 2 + nd))
+        if ptype == 'max':
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    ksize = _pair(ksize, nd)
+    strides = _pair(strides, nd)
+    pads = _pair(pads, nd)
+    window = (1, 1) + ksize
+    wstrides = (1, 1) + strides
+    padding = [(0, 0), (0, 0)]
+    for i in range(nd):
+        hi = pads[i]
+        if ceil_mode:
+            size = x.shape[2 + i]
+            out = -(-(size + 2 * pads[i] - ksize[i]) // strides[i]) + 1
+            needed = (out - 1) * strides[i] + ksize[i] - size - pads[i]
+            hi = max(pads[i], needed)
+        padding.append((pads[i], hi))
+    if ptype == 'max':
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, wstrides, padding)
+    s = lax.reduce_window(x, 0.0, lax.add, window, wstrides, padding)
+    if exclusive:
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, wstrides, padding)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+@register('pool2d')
+def pool2d(ctx, ins, attrs):
+    return {'Out': _pool(ins['X'], attrs.get('ksize', [2, 2]),
+                         attrs.get('strides', [1, 1]),
+                         attrs.get('paddings', [0, 0]),
+                         attrs.get('pooling_type', 'max'),
+                         attrs.get('exclusive', True),
+                         attrs.get('ceil_mode', False),
+                         attrs.get('global_pooling', False), nd=2)}
+
+
+@register('pool3d')
+def pool3d(ctx, ins, attrs):
+    return {'Out': _pool(ins['X'], attrs.get('ksize', [2, 2, 2]),
+                         attrs.get('strides', [1, 1, 1]),
+                         attrs.get('paddings', [0, 0, 0]),
+                         attrs.get('pooling_type', 'max'),
+                         attrs.get('exclusive', True),
+                         attrs.get('ceil_mode', False),
+                         attrs.get('global_pooling', False), nd=3)}
+
+
+def _adaptive_pool(x, out_size, ptype, nd=2):
+    axes_sizes = x.shape[2:2 + nd]
+    out_size = _pair(out_size, nd)
+    # decompose into even windows when divisible (common case), else resize
+    ks = []
+    for s, o in zip(axes_sizes, out_size):
+        assert s % o == 0, 'adaptive pool needs divisible sizes on TPU'
+        ks.append(s // o)
+    return _pool(x, ks, ks, [0] * nd, ptype, True, False, False, nd=nd)
+
+
+@register('adaptive_pool2d')
+def adaptive_pool2d(ctx, ins, attrs):
+    return {'Out': _adaptive_pool(ins['X'], attrs['ksize'],
+                                  attrs.get('pooling_type', 'max'), 2)}
+
+
+@register('adaptive_pool3d')
+def adaptive_pool3d(ctx, ins, attrs):
+    return {'Out': _adaptive_pool(ins['X'], attrs['ksize'],
+                                  attrs.get('pooling_type', 'max'), 3)}
+
+
+@register('batch_norm')
+def batch_norm(ctx, ins, attrs):
+    x = ins['X']
+    scale, bias = ins['Scale'], ins['Bias']
+    mean, var = ins['Mean'], ins['Variance']
+    eps = attrs.get('epsilon', 1e-5)
+    momentum = attrs.get('momentum', 0.9)
+    is_test = attrs.get('is_test', False)
+    layout = attrs.get('data_layout', 'NCHW')
+    ch_axis = 1 if layout == 'NCHW' else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test or attrs.get('use_global_stats', False):
+        m, v = mean, var
+        y = (x - m.reshape(bshape)) * (
+            scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + eps)) + \
+            bias.reshape(bshape)
+        return {'Y': y, 'MeanOut': mean, 'VarianceOut': var,
+                'SavedMean': m, 'SavedVariance': v}
+    m = jnp.mean(x, axis=axes)
+    v = jnp.mean(jnp.square(x - m.reshape(bshape)), axis=axes)
+    y = (x - m.reshape(bshape)) * (
+        scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + eps)) + \
+        bias.reshape(bshape)
+    new_mean = lax.stop_gradient(momentum * mean + (1 - momentum) * m)
+    new_var = lax.stop_gradient(momentum * var + (1 - momentum) * v)
+    return {'Y': y, 'MeanOut': new_mean, 'VarianceOut': new_var,
+            'SavedMean': m, 'SavedVariance': v}
+
+
+@register('layer_norm')
+def layer_norm(ctx, ins, attrs):
+    x = ins['X']
+    begin = attrs.get('begin_norm_axis', 1)
+    eps = attrs.get('epsilon', 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.mean(jnp.square(x - m), axis=axes, keepdims=True)
+    y = (x - m) * lax.rsqrt(v + eps)
+    norm_shape = x.shape[begin:]
+    if 'Scale' in ins:
+        y = y * ins['Scale'].reshape(norm_shape)
+    if 'Bias' in ins:
+        y = y + ins['Bias'].reshape(norm_shape)
+    return {'Y': y, 'Mean': m.reshape(x.shape[:begin]),
+            'Variance': v.reshape(x.shape[:begin])}
+
+
+@register('group_norm')
+def group_norm(ctx, ins, attrs):
+    x = ins['X']  # NCHW
+    g = attrs.get('groups', 1)
+    eps = attrs.get('epsilon', 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=axes, keepdims=True)
+    v = jnp.mean(jnp.square(xg - m), axis=axes, keepdims=True)
+    y = ((xg - m) * lax.rsqrt(v + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if 'Scale' in ins:
+        y = y * ins['Scale'].reshape(bshape)
+    if 'Bias' in ins:
+        y = y + ins['Bias'].reshape(bshape)
+    return {'Y': y, 'Mean': m.reshape(n, g), 'Variance': v.reshape(n, g)}
+
+
+@register('data_norm')
+def data_norm(ctx, ins, attrs):
+    x = ins['X']
+    sizes, sums, sqsums = ins['BatchSize'], ins['BatchSum'], ins['BatchSquareSum']
+    means = sums / sizes
+    scales = lax.rsqrt(sqsums / sizes - jnp.square(means) + 1e-4)
+    return {'Y': (x - means) * scales, 'Means': means, 'Scales': scales}
+
+
+@register('softmax')
+def softmax(ctx, ins, attrs):
+    return {'Out': jax.nn.softmax(ins['X'], axis=attrs.get('axis', -1))}
+
+
+@register('log_softmax')
+def log_softmax(ctx, ins, attrs):
+    return {'Out': jax.nn.log_softmax(ins['X'], axis=attrs.get('axis', -1))}
+
+
+@register('dropout')
+def dropout(ctx, ins, attrs):
+    x = ins['X']
+    p = attrs.get('dropout_prob', 0.5)
+    is_test = attrs.get('is_test', False)
+    impl = attrs.get('dropout_implementation', 'downgrade_in_infer')
+    if is_test:
+        out = x * (1.0 - p) if impl == 'downgrade_in_infer' else x
+        return {'Out': out, 'Mask': jnp.ones_like(x)}
+    seed = attrs.get('seed', 0)
+    key = jax.random.key(seed) if seed else ctx.rng()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    out = x * mask
+    if impl == 'upscale_in_train' and p < 1.0:
+        out = out / (1.0 - p)
+    return {'Out': out, 'Mask': mask}
+
+
+@register('lrn')
+def lrn(ctx, ins, attrs):
+    x = ins['X']  # NCHW
+    n = attrs.get('n', 5)
+    k = attrs.get('k', 2.0)
+    alpha = attrs.get('alpha', 1e-4)
+    beta = attrs.get('beta', 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return {'Out': x / jnp.power(mid, beta), 'MidOut': mid}
+
+
+@register('l2_norm_layer')
+def l2_norm_layer(ctx, ins, attrs):
+    x = ins['X']
+    return {'Out': x / jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))}
+
+
+def _resize(x, out_h, out_w, method, align_corners):
+    n, c, h, w = x.shape
+    xt = x.transpose(0, 2, 3, 1)
+    out = jax.image.resize(xt, (n, out_h, out_w, c), method=method)
+    return out.transpose(0, 3, 1, 2)
+
+
+@register('bilinear_interp')
+def bilinear_interp(ctx, ins, attrs):
+    x = ins['X']
+    out_h, out_w = attrs['out_h'], attrs['out_w']
+    if 'OutSize' in ins:
+        pass  # dynamic size unsupported under XLA; use attrs
+    return {'Out': _resize(x, out_h, out_w, 'bilinear',
+                           attrs.get('align_corners', True))}
+
+
+@register('nearest_interp')
+def nearest_interp(ctx, ins, attrs):
+    x = ins['X']
+    return {'Out': _resize(x, attrs['out_h'], attrs['out_w'], 'nearest',
+                           attrs.get('align_corners', True))}
+
+
+@register('affine_channel')
+def affine_channel(ctx, ins, attrs):
+    x, scale, bias = ins['X'], ins['Scale'], ins['Bias']
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    return {'Out': x * scale.reshape(bshape) + bias.reshape(bshape)}
+
+
+@register('row_conv')
+def row_conv(ctx, ins, attrs):
+    # lookahead row convolution over time (ref row_conv_op.cc); x: [B, T, D]
+    x, w = ins['X'], ins['Filter']  # w: [future_ctx, D]
+    k = w.shape[0]
+    pad = jnp.pad(x, [(0, 0), (0, k - 1), (0, 0)])
+    out = sum(pad[:, i:i + x.shape[1]] * w[i] for i in range(k))
+    return {'Out': out}
+
+
+@register('conv_shift')
+def conv_shift(ctx, ins, attrs):
+    x, y = ins['X'], ins['Y']  # [B, M], [B, N] N odd
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    return {'Out': jnp.einsum('bmn,bn->bm', x[:, idx], y)}
+
+
+@register('im2sequence')
+def im2sequence(ctx, ins, attrs):
+    x = ins['X']  # NCHW
+    kh, kw = attrs['kernels']
+    sh, sw = attrs.get('strides', [1, 1])
+    n, c, h, w = x.shape
+    patches = []
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    for i in range(oh):
+        for j in range(ow):
+            patches.append(x[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+                           .reshape(n, -1))
+    out = jnp.stack(patches, axis=1)  # [N, oh*ow, c*kh*kw]
+    return {'Out': out}
+
+
+@register('grid_sampler')
+def grid_sampler(ctx, ins, attrs):
+    x, grid = ins['X'], ins['Grid']  # x NCHW, grid [N, H, W, 2] in [-1,1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def sample(yi, xi):
+        yi = jnp.clip(yi, 0, h - 1)
+        xi = jnp.clip(xi, 0, w - 1)
+        bidx = jnp.arange(n)[:, None, None]
+        return x[bidx, :, yi, xi]  # [N, H, W, C]
+
+    wa = ((x1 - gx) * (y1 - gy))[..., None]
+    wb = ((x1 - gx) * (gy - y0))[..., None]
+    wc = ((gx - x0) * (y1 - gy))[..., None]
+    wd = ((gx - x0) * (gy - y0))[..., None]
+    out = wa * sample(y0, x0) + wb * sample(y1, x0) + \
+        wc * sample(y0, x1) + wd * sample(y1, x1)
+    return {'Output': out.transpose(0, 3, 1, 2)}
+
+
+@register('affine_grid')
+def affine_grid(ctx, ins, attrs):
+    theta = ins['Theta']  # [N, 2, 3]
+    n = theta.shape[0]
+    _, _, h, w = attrs['output_shape'] if 'output_shape' in attrs else \
+        (0, 0, 0, 0)
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    out = jnp.einsum('hwk,nik->nhwi', base, theta)
+    return {'Output': out}
+
+
+@register('add_position_encoding')
+def add_position_encoding(ctx, ins, attrs):
+    x = ins['X']  # [B, T, D]
+    alpha = attrs.get('alpha', 1.0)
+    beta = attrs.get('beta', 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    half = d // 2
+    div = jnp.power(10000.0, jnp.arange(half, dtype=x.dtype) / half)
+    pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], axis=1)
+    return {'Out': alpha * x + beta * pe[None, :, :]}
+
+
+@register('similarity_focus')
+def similarity_focus(ctx, ins, attrs):
+    x = ins['X']
+    axis = attrs['axis']
+    indexes = attrs['indexes']
+    sel = jnp.take(x, jnp.array(indexes), axis=axis)
+    mx = jnp.max(sel, axis=axis, keepdims=True)
+    mask = (x == jnp.max(mx, axis=tuple(range(2, x.ndim)), keepdims=True))
+    return {'Out': jnp.where(mask, jnp.ones_like(x), jnp.zeros_like(x))}

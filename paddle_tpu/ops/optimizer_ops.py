@@ -1,0 +1,157 @@
+"""Optimizer update ops — pure functional updates fused into the train step.
+
+Parity: reference paddle/fluid/operators/optimizers/ (sgd_op, momentum_op,
+adam_op, adagrad_op, adamax_op, adadelta_op, rmsprop_op, ftrl_op,
+decayed_adagrad_op, lars_momentum_op).  The whole update runs inside the one
+jitted train-step executable with parameter buffers donated, so updates are
+in-place on device.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+def _lr(ins):
+    lr = ins['LearningRate']
+    return lr.reshape(()) if hasattr(lr, 'reshape') else lr
+
+
+@register('sgd')
+def sgd(ctx, ins, attrs):
+    return {'ParamOut': ins['Param'] - _lr(ins) * ins['Grad']}
+
+
+@register('momentum')
+def momentum(ctx, ins, attrs):
+    p, g, v = ins['Param'], ins['Grad'], ins['Velocity']
+    mu = attrs.get('mu', 0.9)
+    lr = _lr(ins)
+    v_new = mu * v + g
+    if attrs.get('use_nesterov', False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {'ParamOut': p_new, 'VelocityOut': v_new}
+
+
+@register('lars_momentum')
+def lars_momentum(ctx, ins, attrs):
+    p, g, v = ins['Param'], ins['Grad'], ins['Velocity']
+    mu = attrs.get('mu', 0.9)
+    coeff = attrs.get('lars_coeff', 0.001)
+    decay = attrs.get('lars_weight_decay', 0.0005)
+    lr = _lr(ins)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = lr * coeff * pn / (gn + decay * pn + 1e-12)
+    v_new = mu * v + local_lr * (g + decay * p)
+    return {'ParamOut': p - v_new, 'VelocityOut': v_new}
+
+
+@register('adam')
+def adam(ctx, ins, attrs):
+    p, g = ins['Param'], ins['Grad']
+    m1, m2 = ins['Moment1'], ins['Moment2']
+    b1p, b2p = ins['Beta1Pow'], ins['Beta2Pow']
+    b1 = attrs.get('beta1', 0.9)
+    b2 = attrs.get('beta2', 0.999)
+    eps = attrs.get('epsilon', 1e-8)
+    lr = _lr(ins)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    pn = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {'ParamOut': pn, 'Moment1Out': m1n, 'Moment2Out': m2n,
+            'Beta1PowOut': b1p * b1, 'Beta2PowOut': b2p * b2}
+
+
+@register('adamax')
+def adamax(ctx, ins, attrs):
+    p, g = ins['Param'], ins['Grad']
+    m, u = ins['Moment'], ins['InfNorm']
+    b1p = ins['Beta1Pow']
+    b1 = attrs.get('beta1', 0.9)
+    b2 = attrs.get('beta2', 0.999)
+    eps = attrs.get('epsilon', 1e-8)
+    lr = _lr(ins)
+    mn = b1 * m + (1 - b1) * g
+    un = jnp.maximum(b2 * u, jnp.abs(g))
+    pn = p - (lr / (1 - b1p.reshape(()))) * mn / (un + eps)
+    return {'ParamOut': pn, 'MomentOut': mn, 'InfNormOut': un}
+
+
+@register('adagrad')
+def adagrad(ctx, ins, attrs):
+    p, g, mom = ins['Param'], ins['Grad'], ins['Moment']
+    eps = attrs.get('epsilon', 1e-6)
+    mn = mom + jnp.square(g)
+    return {'ParamOut': p - _lr(ins) * g / (jnp.sqrt(mn) + eps),
+            'MomentOut': mn}
+
+
+@register('decayed_adagrad')
+def decayed_adagrad(ctx, ins, attrs):
+    p, g, mom = ins['Param'], ins['Grad'], ins['Moment']
+    decay = attrs.get('decay', 0.95)
+    eps = attrs.get('epsilon', 1e-6)
+    mn = decay * mom + (1 - decay) * jnp.square(g)
+    return {'ParamOut': p - _lr(ins) * g / (jnp.sqrt(mn) + eps),
+            'MomentOut': mn}
+
+
+@register('adadelta')
+def adadelta(ctx, ins, attrs):
+    p, g = ins['Param'], ins['Grad']
+    avg_sq_g, avg_sq_u = ins['AvgSquaredGrad'], ins['AvgSquaredUpdate']
+    rho = attrs.get('rho', 0.95)
+    eps = attrs.get('epsilon', 1e-6)
+    gn = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (gn + eps)) * g
+    un = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {'ParamOut': p + update, 'AvgSquaredGradOut': gn,
+            'AvgSquaredUpdateOut': un}
+
+
+@register('rmsprop')
+def rmsprop(ctx, ins, attrs):
+    p, g = ins['Param'], ins['Grad']
+    ms, mom = ins['MeanSquare'], ins['Moment']
+    rho = attrs.get('decay', 0.95)
+    eps = attrs.get('epsilon', 1e-6)
+    mu = attrs.get('momentum', 0.0)
+    lr = _lr(ins)
+    msn = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get('centered', False):
+        mg = ins['MeanGrad']
+        mgn = rho * mg + (1 - rho) * g
+        momn = mu * mom + lr * g / jnp.sqrt(msn - jnp.square(mgn) + eps)
+        return {'ParamOut': p - momn, 'MeanSquareOut': msn,
+                'MomentOut': momn, 'MeanGradOut': mgn}
+    momn = mu * mom + lr * g / jnp.sqrt(msn + eps)
+    return {'ParamOut': p - momn, 'MeanSquareOut': msn, 'MomentOut': momn}
+
+
+@register('ftrl')
+def ftrl(ctx, ins, attrs):
+    p, g = ins['Param'], ins['Grad']
+    sq, lin = ins['SquaredAccumulator'], ins['LinearAccumulator']
+    l1 = attrs.get('l1', 0.0) + 1e-10
+    l2 = attrs.get('l2', 0.0) + 1e-10
+    power = attrs.get('lr_power', -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    if power == -0.5:
+        denom = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        denom = l2 + jnp.power(new_sq, -power) / lr
+    pn = jnp.where(jnp.abs(new_lin) > l1,
+                   (l1 * jnp.sign(new_lin) - new_lin) / denom,
+                   jnp.zeros_like(p))
+    return {'ParamOut': pn, 'SquaredAccumOut': new_sq,
+            'LinearAccumOut': new_lin}

@@ -1,0 +1,80 @@
+"""Random + initializer ops.
+
+Parity: reference uniform_random_op, gaussian_random_op,
+truncated_gaussian_random_op, fill ops used by initializers, sampling_id_op,
+random_crop_op.  All use JAX threefry keys derived from the run seed.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register
+from ..core.dtypes import convert_dtype
+
+
+def _key(ctx, attrs):
+    seed = attrs.get('seed', 0)
+    return jax.random.key(seed) if seed else ctx.rng()
+
+
+@register('uniform_random')
+def uniform_random(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    shape = [int(d) for d in attrs['shape']]
+    out = jax.random.uniform(_key(ctx, attrs), shape,
+                             minval=attrs.get('min', -1.0),
+                             maxval=attrs.get('max', 1.0))
+    return {'Out': out.astype(dtype)}
+
+
+@register('gaussian_random')
+def gaussian_random(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    shape = [int(d) for d in attrs['shape']]
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
+        jax.random.normal(_key(ctx, attrs), shape)
+    return {'Out': out.astype(dtype)}
+
+
+@register('truncated_gaussian_random')
+def truncated_gaussian_random(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    shape = [int(d) for d in attrs['shape']]
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
+        jax.random.truncated_normal(_key(ctx, attrs), -2.0, 2.0, shape)
+    return {'Out': out.astype(dtype)}
+
+
+@register('sampling_id')
+def sampling_id(ctx, ins, attrs):
+    x = ins['X']  # [B, C] probabilities
+    key = _key(ctx, attrs)
+    ids = jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
+    return {'Out': ids.astype(jnp.int64)}
+
+
+@register('random_crop')
+def random_crop(ctx, ins, attrs):
+    x = ins['X']
+    shape = attrs['shape']  # crop shape for trailing dims
+    key = _key(ctx, attrs)
+    nlead = x.ndim - len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[nlead + i] - s
+        k = jax.random.fold_in(key, i)
+        starts.append(jax.random.randint(k, (), 0, max(limit, 0) + 1))
+    idx = tuple([slice(None)] * nlead)
+    out = jax.lax.dynamic_slice(
+        x, [0] * nlead + [s for s in starts],
+        list(x.shape[:nlead]) + list(shape))
+    return {'Out': out}
+
+
+@register('crop')
+def crop(ctx, ins, attrs):
+    x = ins['X']
+    shape = attrs.get('shape')
+    if 'Y' in ins:
+        shape = ins['Y'].shape
+    offsets = attrs.get('offsets', [0] * x.ndim)
+    return {'Out': jax.lax.dynamic_slice(x, offsets, shape)}

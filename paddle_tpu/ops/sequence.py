@@ -1,0 +1,382 @@
+"""Sequence ops over padded-batch (+lengths) representation, and RNNs.
+
+Parity: reference sequence_pool_op, sequence_softmax_op, sequence_expand_op,
+sequence_conv_op, sequence_pad/unpad, sequence_mask, sequence_reverse,
+sequence_slice, sequence_concat, sequence_enumerate, lstm_op, gru_op.
+
+TPU-native redesign: the reference walks CPU-side LoD offset tables per
+sequence; here every op is a masked dense computation over [B, T, ...] with
+an int32 `Length` [B] input — static shapes, vectorized over the batch, and
+RNN recurrences are `lax.scan` (single compiled loop, no Python unrolling).
+Ragged inputs are converted once at feed time (core/lod.py).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+
+def _mask(x, length):
+    """[B, T] validity mask broadcastable to x [B, T, ...]."""
+    B, T = x.shape[0], x.shape[1]
+    m = jnp.arange(T)[None, :] < length[:, None]
+    return m.reshape((B, T) + (1,) * (x.ndim - 2))
+
+
+def _length_or_full(ins, x):
+    if 'Length' in ins and ins['Length'] is not None:
+        return ins['Length']
+    return jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+
+
+@register('sequence_pool')
+def sequence_pool(ctx, ins, attrs):
+    x = ins['X']  # [B, T, ...]
+    length = _length_or_full(ins, x)
+    ptype = attrs.get('pooltype', 'AVERAGE').upper()
+    m = _mask(x, length)
+    mf = m.astype(x.dtype)
+    cnt = jnp.maximum(length.astype(x.dtype), 1).reshape(
+        (-1,) + (1,) * (x.ndim - 2))
+    if ptype == 'SUM':
+        out = jnp.sum(x * mf, axis=1)
+    elif ptype == 'AVERAGE':
+        out = jnp.sum(x * mf, axis=1) / cnt
+    elif ptype == 'SQRT':
+        out = jnp.sum(x * mf, axis=1) / jnp.sqrt(cnt)
+    elif ptype == 'MAX':
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m, x, neg), axis=1)
+    elif ptype == 'LAST':
+        idx = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    elif ptype == 'FIRST':
+        out = x[:, 0]
+    else:
+        raise ValueError('bad pooltype %s' % ptype)
+    return {'Out': out, 'MaxIndex': None}
+
+
+@register('sequence_softmax')
+def sequence_softmax(ctx, ins, attrs):
+    x = ins['X']  # [B, T] or [B, T, 1]
+    length = _length_or_full(ins, x)
+    m = _mask(x, length)
+    neg = jnp.finfo(x.dtype).min
+    out = jax.nn.softmax(jnp.where(m, x, neg), axis=1)
+    return {'Out': out * m.astype(x.dtype)}
+
+
+@register('sequence_expand')
+def sequence_expand(ctx, ins, attrs):
+    # x: [B, ...] (one row per sequence), y gives target lengths ->
+    # out: [B, T, ...] rows repeated along new time dim, masked by y length
+    x, y = ins['X'], ins['Y']
+    T = y.shape[1]
+    if x.ndim == y.ndim:  # x already [B, T, ...]: tile row-wise not needed
+        return {'Out': x}
+    out = jnp.repeat(x[:, None], T, axis=1)
+    return {'Out': out}
+
+
+@register('sequence_expand_as')
+def sequence_expand_as(ctx, ins, attrs):
+    x, y = ins['X'], ins['Y']
+    T = y.shape[1]
+    out = jnp.repeat(x[:, None], T, axis=1)
+    return {'Out': out}
+
+
+@register('sequence_reverse')
+def sequence_reverse(ctx, ins, attrs):
+    x = ins['X']
+    length = _length_or_full(ins, x)
+    T = x.shape[1]
+    # reverse only the valid prefix: index (len-1-t) mod T for t < len
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < length[:, None], length[:, None] - 1 - t, t)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return {'Y': out}
+
+
+@register('sequence_conv')
+def sequence_conv(ctx, ins, attrs):
+    x, w = ins['X'], ins['Filter']  # x [B, T, D], w [ctx_len*D, out]
+    length = _length_or_full(ins, x)
+    ctx_len = attrs.get('contextLength', 3)
+    ctx_start = attrs.get('contextStart', -(ctx_len // 2))
+    B, T, D = x.shape
+    xm = x * _mask(x, length).astype(x.dtype)
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(xm, -off, axis=1)
+        t = jnp.arange(T)
+        valid = (t + off >= 0) & (t + off < T)
+        cols.append(shifted * valid[None, :, None].astype(x.dtype))
+    col = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    out = col @ w
+    return {'Out': out * _mask(out, length).astype(out.dtype)}
+
+
+@register('sequence_pad')
+def sequence_pad(ctx, ins, attrs):
+    x = ins['X']
+    length = _length_or_full(ins, x)
+    # already padded in our representation
+    return {'Out': x, 'Length': length.astype(jnp.int64)}
+
+
+@register('sequence_unpad')
+def sequence_unpad(ctx, ins, attrs):
+    x, length = ins['X'], ins['Length']
+    return {'Out': x, 'OutLength': length.astype(jnp.int32)}
+
+
+@register('sequence_mask')
+def sequence_mask(ctx, ins, attrs):
+    x = ins['X']  # lengths tensor
+    maxlen = attrs.get('maxlen', -1)
+    from ..core.dtypes import convert_dtype
+    dtype = convert_dtype(attrs.get('out_dtype', 'int64'))
+    if maxlen is None or maxlen < 0:
+        raise ValueError('sequence_mask on TPU requires static maxlen attr')
+    m = jnp.arange(maxlen)[None, :] < x.reshape(-1, 1)
+    m = m.reshape(tuple(x.shape) + (maxlen,))
+    return {'Y': m.astype(dtype)}
+
+
+@register('sequence_slice')
+def sequence_slice(ctx, ins, attrs):
+    x, offset, length = ins['X'], ins['Offset'], ins['Length']
+    T = x.shape[1]
+    off = offset.reshape(-1)
+    t = jnp.arange(T)[None, :]
+    idx = jnp.minimum(off[:, None] + t, T - 1)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    m = (t < length.reshape(-1)[:, None]).reshape(
+        (x.shape[0], T) + (1,) * (x.ndim - 2))
+    return {'Out': out * m.astype(x.dtype)}
+
+
+@register('sequence_concat')
+def sequence_concat(ctx, ins, attrs):
+    xs = ins['X']
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return {'Out': jnp.concatenate(xs, axis=1)}
+
+
+@register('sequence_enumerate')
+def sequence_enumerate(ctx, ins, attrs):
+    x = ins['X']  # [B, T] or [B, T, 1] int
+    win = attrs['win_size']
+    pad_value = attrs.get('pad_value', 0)
+    squeeze = x.ndim == 3
+    ids = x[..., 0] if squeeze else x
+    B, T = ids.shape
+    outs = []
+    for i in range(win):
+        shifted = jnp.roll(ids, -i, axis=1)
+        valid = (jnp.arange(T) + i < T)[None, :]
+        outs.append(jnp.where(valid, shifted, pad_value))
+    out = jnp.stack(outs, axis=-1)  # [B, T, win]
+    return {'Out': out}
+
+
+@register('sequence_reshape')
+def sequence_reshape(ctx, ins, attrs):
+    x = ins['X']  # [B, T, D]
+    new_dim = attrs['new_dim']
+    B, T, D = x.shape
+    return {'Out': x.reshape(B, T * D // new_dim, new_dim)}
+
+
+@register('sequence_scatter')
+def sequence_scatter(ctx, ins, attrs):
+    x, ids, updates = ins['X'], ins['Ids'], ins['Updates']
+    # ids/updates: [B, T(,1)] — scatter-add along dim 1 of x
+    idx = ids[..., 0] if ids.ndim == 3 else ids
+    upd = updates[..., 0] if updates.ndim == 3 else updates
+    b = jnp.arange(x.shape[0])[:, None]
+    return {'Out': x.at[b, idx].add(upd.astype(x.dtype))}
+
+
+@register('sequence_erase')
+def sequence_erase(ctx, ins, attrs):
+    raise NotImplementedError(
+        'sequence_erase produces data-dependent lengths; mask tokens instead')
+
+
+# --------------------------------------------------------------- RNNs
+
+def _lstm_scan(xproj, h0, c0, w, bias, length, gate_act, cell_act, cand_act,
+               use_peepholes, is_reverse):
+    """xproj: [B, T, 4D] already input-projected; w: [D, 4D] recurrent.
+    Gate layout: [i, f, g(candidate), o] (internal convention; reference
+    lstm_op.h uses its own fixed order — self-consistent end-to-end here)."""
+    B, T, D4 = xproj.shape
+    D = D4 // 4
+    if is_reverse:
+        xproj = jnp.flip(xproj, axis=1)
+    tmask = (jnp.arange(T)[None, :] < length[:, None]).astype(xproj.dtype)
+    if is_reverse:
+        tmask = jnp.flip(tmask, axis=1)
+    xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4D]
+    ms = jnp.swapaxes(tmask, 0, 1)  # [T, B]
+    if use_peepholes:
+        b_g, w_ic, w_fc, w_oc = (bias[:, :4 * D], bias[:, 4 * D:5 * D],
+                                 bias[:, 5 * D:6 * D], bias[:, 6 * D:7 * D])
+    else:
+        b_g = bias
+        w_ic = w_fc = w_oc = None
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = xt + h @ w + b_g
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = gate_act(i), gate_act(f)
+        g = cand_act(g)
+        c_new = f * c + i * g
+        if use_peepholes:
+            o = o + c_new * w_oc
+        o = gate_act(o)
+        h_new = o * cell_act(c_new)
+        m = mt[:, None]
+        h = m * h_new + (1 - m) * h
+        c = m * c_new + (1 - m) * c
+        return (h, c), (h, c)
+
+    (hT, cT), (hs, cs) = lax.scan(step, (h0, c0), (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, axis=1)
+        cs = jnp.flip(cs, axis=1)
+    return hs, cs, hT, cT
+
+
+_ACTS = {'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh, 'relu': jax.nn.relu,
+         'identity': lambda x: x, 'hard_sigmoid': lambda x: jnp.clip(
+             0.2 * x + 0.5, 0., 1.)}
+
+
+@register('lstm')
+def lstm(ctx, ins, attrs):
+    """dynamic_lstm (ref lstm_op.cc): Input [B, T, 4D] (pre-projected),
+    Weight [D, 4D], Bias [1, 4D or 7D]."""
+    x = ins['Input']
+    w = ins['Weight']
+    bias = ins['Bias']
+    length = _length_or_full(ins, x)
+    D = w.shape[0]
+    B = x.shape[0]
+    h0 = ins.get('H0', None) if isinstance(ins, dict) else None
+    c0 = ins.get('C0', None)
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((B, D), x.dtype)
+    hs, cs, _, _ = _lstm_scan(
+        x, h0, c0, w, bias, length,
+        _ACTS[attrs.get('gate_activation', 'sigmoid')],
+        _ACTS[attrs.get('cell_activation', 'tanh')],
+        _ACTS[attrs.get('candidate_activation', 'tanh')],
+        attrs.get('use_peepholes', True),
+        attrs.get('is_reverse', False))
+    return {'Hidden': hs, 'Cell': cs}
+
+
+@register('cudnn_lstm')
+def cudnn_lstm(ctx, ins, attrs):
+    """Multi-layer LSTM (ref cudnn_lstm_op): here just stacked scans."""
+    x = ins['Input']  # [B, T, D_in]
+    raise NotImplementedError('use layers.lstm / dynamic_lstm')
+
+
+@register('gru')
+def gru(ctx, ins, attrs):
+    """dynamic_gru (ref gru_op.cc): Input [B, T, 3D] pre-projected,
+    Weight [D, 3D] laid out as [W_update|W_reset|W_candidate], Bias [1,3D]."""
+    x = ins['Input']
+    w = ins['Weight']
+    bias = ins.get('Bias')
+    length = _length_or_full(ins, x)
+    D = w.shape[0]
+    B, T, _ = x.shape
+    h0 = ins.get('H0')
+    if h0 is None:
+        h0 = jnp.zeros((B, D), x.dtype)
+    if bias is None:
+        bias = jnp.zeros((1, 3 * D), x.dtype)
+    gact = _ACTS[attrs.get('gate_activation', 'sigmoid')]
+    cact = _ACTS[attrs.get('activation', 'tanh')]
+    is_reverse = attrs.get('is_reverse', False)
+    w_ur = w[:, :2 * D]
+    w_c = w[:, 2 * D:]
+    if is_reverse:
+        x = jnp.flip(x, axis=1)
+    tmask = (jnp.arange(T)[None, :] < length[:, None]).astype(x.dtype)
+    if is_reverse:
+        tmask = jnp.flip(tmask, axis=1)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(tmask, 0, 1)
+
+    def step(h, inp):
+        xt, mt = inp
+        xu, xr, xc = jnp.split(xt + bias, 3, axis=-1)
+        ur = gact(jnp.concatenate([xu, xr], -1) + h @ w_ur)
+        u, r = jnp.split(ur, 2, axis=-1)
+        c = cact(xc + (r * h) @ w_c)
+        h_new = u * h + (1 - u) * c
+        m = mt[:, None]
+        h = m * h_new + (1 - m) * h
+        return h, h
+
+    hT, hs = lax.scan(step, h0, (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        hs = jnp.flip(hs, axis=1)
+    return {'Hidden': hs}
+
+
+@register('gru_unit')
+def gru_unit(ctx, ins, attrs):
+    x, h_prev, w = ins['Input'], ins['HiddenPrev'], ins['Weight']
+    D = h_prev.shape[-1]
+    bias = ins.get('Bias')
+    if bias is None:
+        bias = jnp.zeros((1, 3 * D), x.dtype)
+    gact = _ACTS.get(
+        {1: 'sigmoid', 2: 'tanh', 0: 'identity', 3: 'relu'}.get(
+            attrs.get('gate_activation', 1), 'sigmoid'))
+    cact = _ACTS.get(
+        {1: 'sigmoid', 2: 'tanh', 0: 'identity', 3: 'relu'}.get(
+            attrs.get('activation', 2), 'tanh'))
+    xu, xr, xc = jnp.split(x + bias, 3, axis=-1)
+    w_ur, w_c = w[:, :2 * D], w[:, 2 * D:]
+    ur = gact(jnp.concatenate([xu, xr], -1) + h_prev @ w_ur)
+    u, r = jnp.split(ur, 2, axis=-1)
+    c = cact(xc + (r * h_prev) @ w_c)
+    h = u * h_prev + (1 - u) * c
+    return {'Hidden': h, 'Gate': jnp.concatenate([u, r, c], -1),
+            'ResetHiddenPrev': r * h_prev}
+
+
+@register('lstm_unit')
+def lstm_unit(ctx, ins, attrs):
+    x, c_prev = ins['X'], ins['C_prev']
+    forget_bias = attrs.get('forget_bias', 0.0)
+    i, f, g, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {'C': c, 'H': h}

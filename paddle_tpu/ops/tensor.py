@@ -1,0 +1,381 @@
+"""Shape/layout/indexing ops.
+
+Parity: reference operators: reshape_op, transpose_op, concat_op, split_op,
+stack_op, gather_op, scatter_op, slice_op, expand_op, pad_op, one_hot_op,
+lookup_table_op, topk_op, argsort/arg_min_max, fill_constant*, assign, etc.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+from ..core.dtypes import convert_dtype
+
+
+@register('reshape')
+def reshape(ctx, ins, attrs):
+    x = ins['X']
+    shape = list(attrs['shape'])
+    # fluid semantics: 0 -> copy input dim, -1 -> infer
+    out_shape = []
+    for i, d in enumerate(shape):
+        if d == 0:
+            out_shape.append(x.shape[i])
+        else:
+            out_shape.append(int(d))
+    return {'Out': x.reshape(out_shape), 'XShape': None}
+
+
+@register('squeeze')
+def squeeze(ctx, ins, attrs):
+    x = ins['X']
+    axes = attrs.get('axes', [])
+    if not axes:
+        return {'Out': jnp.squeeze(x)}
+    axes = tuple(a % x.ndim for a in axes)
+    return {'Out': jnp.squeeze(x, axis=axes)}
+
+
+@register('unsqueeze')
+def unsqueeze(ctx, ins, attrs):
+    x = ins['X']
+    for a in sorted(attrs['axes']):
+        x = jnp.expand_dims(x, a)
+    return {'Out': x}
+
+
+@register('transpose')
+def transpose(ctx, ins, attrs):
+    return {'Out': jnp.transpose(ins['X'], attrs['axis']), 'XShape': None}
+
+
+@register('flatten')
+def flatten(ctx, ins, attrs):
+    x = ins['X']
+    ax = attrs.get('axis', 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    return {'Out': x.reshape(lead, -1)}
+
+
+@register('concat')
+def concat(ctx, ins, attrs):
+    xs = ins['X']
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return {'Out': jnp.concatenate(xs, axis=attrs.get('axis', 0))}
+
+
+@register('split')
+def split(ctx, ins, attrs):
+    x = ins['X']
+    axis = attrs.get('axis', 0)
+    sections = attrs.get('sections', [])
+    num = attrs.get('num', 0)
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {'Out': list(outs)}
+
+
+@register('stack')
+def stack(ctx, ins, attrs):
+    xs = ins['X']
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return {'Y': jnp.stack(xs, axis=attrs.get('axis', 0))}
+
+
+@register('unstack')
+def unstack(ctx, ins, attrs):
+    x = ins['X']
+    axis = attrs.get('axis', 0)
+    n = x.shape[axis]
+    return {'Y': [jnp.squeeze(a, axis) for a in jnp.split(x, n, axis)]}
+
+
+@register('expand')
+def expand(ctx, ins, attrs):
+    x = ins['X']
+    times = attrs['expand_times']
+    return {'Out': jnp.tile(x, times)}
+
+
+@register('slice')
+def slice_op(ctx, ins, attrs):
+    x = ins['Input']
+    axes = attrs['axes']
+    starts = attrs['starts']
+    ends = attrs['ends']
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = s + dim if s < 0 else s
+        e = e + dim if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {'Out': x[tuple(idx)]}
+
+
+@register('strided_slice')
+def strided_slice(ctx, ins, attrs):
+    x = ins['Input']
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs['axes'], attrs['starts'], attrs['ends'],
+                           attrs['strides']):
+        idx[a] = slice(s, e, st)
+    return {'Out': x[tuple(idx)]}
+
+
+@register('gather')
+def gather(ctx, ins, attrs):
+    index = ins['Index']
+    if index.ndim == 2 and index.shape[1] == 1:
+        index = index[:, 0]
+    return {'Out': jnp.take(ins['X'], index, axis=0)}
+
+
+@register('scatter')
+def scatter(ctx, ins, attrs):
+    x, ids, updates = ins['X'], ins['Ids'], ins['Updates']
+    if ids.ndim == 2 and ids.shape[1] == 1:
+        ids = ids[:, 0]
+    if attrs.get('overwrite', True):
+        return {'Out': x.at[ids].set(updates)}
+    return {'Out': x.at[ids].add(updates)}
+
+
+@register('gather_nd')
+def gather_nd(ctx, ins, attrs):
+    x, index = ins['X'], ins['Index']
+    return {'Out': x[tuple(jnp.moveaxis(index, -1, 0))]}
+
+
+@register('pad')
+def pad(ctx, ins, attrs):
+    x = ins['X']
+    p = attrs['paddings']
+    pad_width = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {'Out': jnp.pad(x, pad_width,
+                           constant_values=attrs.get('pad_value', 0.0))}
+
+
+@register('pad2d')
+def pad2d(ctx, ins, attrs):
+    x = ins['X']  # NCHW
+    p = attrs['paddings']  # [top, bottom, left, right]
+    mode = attrs.get('mode', 'constant')
+    pw = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if attrs.get('data_format', 'NCHW') == 'NHWC':
+        pw = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == 'constant':
+        return {'Out': jnp.pad(x, pw,
+                               constant_values=attrs.get('pad_value', 0.0))}
+    jmode = {'reflect': 'reflect', 'edge': 'edge'}[mode]
+    return {'Out': jnp.pad(x, pw, mode=jmode)}
+
+
+@register('pad_constant_like')
+def pad_constant_like(ctx, ins, attrs):
+    x, y = ins['X'], ins['Y']
+    pw = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return {'Out': jnp.pad(y, pw, constant_values=attrs.get('pad_value', 0.0))}
+
+
+@register('one_hot')
+def one_hot(ctx, ins, attrs):
+    x = ins['X']
+    depth = attrs['depth']
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return {'Out': jax.nn.one_hot(x, depth, dtype=jnp.float32)}
+
+
+@register('lookup_table')
+def lookup_table(ctx, ins, attrs):
+    # reference lookup_table_op.cc: ids [..., 1] int64, W [V, D]
+    w, ids = ins['W'], ins['Ids']
+    padding_idx = attrs.get('padding_idx', -1)
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    idx = ids[..., 0] if squeeze_last else ids
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (idx != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {'Out': out}
+
+
+@register('fill_constant')
+def fill_constant(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    shape = [int(d) for d in attrs['shape']]
+    return {'Out': jnp.full(shape, attrs['value'], dtype=dtype)}
+
+
+@register('fill_constant_batch_size_like')
+def fill_constant_batch_size_like(ctx, ins, attrs):
+    ref = ins['Input']
+    shape = list(attrs['shape'])
+    in_idx = attrs.get('input_dim_idx', 0)
+    out_idx = attrs.get('output_dim_idx', 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    return {'Out': jnp.full(shape, attrs['value'], dtype=dtype)}
+
+
+@register('fill_zeros_like')
+def fill_zeros_like(ctx, ins, attrs):
+    return {'Out': jnp.zeros_like(ins['X'])}
+
+
+@register('assign')
+def assign(ctx, ins, attrs):
+    return {'Out': ins['X']}
+
+
+@register('assign_value')
+def assign_value(ctx, ins, attrs):
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    vals = np.array(attrs['values'], dtype=dtype).reshape(attrs['shape'])
+    return {'Out': jnp.asarray(vals)}
+
+
+@register('shape')
+def shape_op(ctx, ins, attrs):
+    return {'Out': jnp.array(ins['Input'].shape, dtype=jnp.int32)}
+
+
+@register('top_k')
+def top_k(ctx, ins, attrs):
+    x = ins['X']
+    k = attrs['k']
+    vals, idx = lax.top_k(x, k)
+    return {'Out': vals, 'Indices': idx.astype(jnp.int64)}
+
+
+@register('arg_max')
+def arg_max(ctx, ins, attrs):
+    return {'Out': jnp.argmax(ins['X'], axis=attrs.get('axis', -1))
+            .astype(jnp.int64)}
+
+
+@register('arg_min')
+def arg_min(ctx, ins, attrs):
+    return {'Out': jnp.argmin(ins['X'], axis=attrs.get('axis', -1))
+            .astype(jnp.int64)}
+
+
+@register('argsort')
+def argsort(ctx, ins, attrs):
+    x = ins['X']
+    axis = attrs.get('axis', -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {'Out': jnp.sort(x, axis=axis), 'Indices': idx.astype(jnp.int64)}
+
+
+@register('reverse')
+def reverse(ctx, ins, attrs):
+    x = ins['X']
+    return {'Out': jnp.flip(x, axis=tuple(a % x.ndim for a in attrs['axis']))}
+
+
+@register('multiplex')
+def multiplex(ctx, ins, attrs):
+    ids = ins['Ids']  # [B, 1] int
+    xs = jnp.stack(ins['X'], axis=0)  # [n, B, D]
+    idx = ids[:, 0]
+    return {'Out': xs[idx, jnp.arange(xs.shape[1])]}
+
+
+@register('expand_as')
+def expand_as(ctx, ins, attrs):
+    x, y = ins['X'], ins['target_tensor']
+    reps = [t // s for s, t in zip(x.shape, y.shape)]
+    return {'Out': jnp.tile(x, reps)}
+
+
+@register('label_smooth')
+def label_smooth(ctx, ins, attrs):
+    x = ins['X']
+    eps = attrs.get('epsilon', 0.0)
+    if 'PriorDist' in ins:
+        prior = ins['PriorDist']
+        return {'Out': (1 - eps) * x + eps * prior}
+    return {'Out': (1 - eps) * x + eps / x.shape[-1]}
+
+
+@register('space_to_depth')
+def space_to_depth(ctx, ins, attrs):
+    x = ins['X']  # NCHW
+    bs = attrs['blocksize']
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return {'Out': x.reshape(n, c * bs * bs, h // bs, w // bs)}
+
+
+@register('shuffle_channel')
+def shuffle_channel(ctx, ins, attrs):
+    x = ins['X']
+    g = attrs['group']
+    n, c, h, w = x.shape
+    return {'Out': x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+            .reshape(n, c, h, w)}
+
+
+@register('where_index')
+def where_index(ctx, ins, attrs):
+    raise NotImplementedError(
+        'where_index has data-dependent output shape; not XLA-compatible')
+
+
+@register('hash')
+def hash_op(ctx, ins, attrs):
+    x = ins['X'].astype(jnp.int64)
+    num_hash = attrs.get('num_hash', 1)
+    mod_by = attrs.get('mod_by', 100000007)
+    outs = []
+    for i in range(num_hash):
+        h = jnp.sum(x * jnp.asarray(1000003 ** (i + 1), jnp.int64), axis=-1,
+                    keepdims=True)
+        outs.append(jnp.abs(h) % mod_by)
+    return {'Out': jnp.concatenate(outs, axis=-1)}
+
+
+@register('uniform_random_batch_size_like')
+def uniform_random_batch_size_like(ctx, ins, attrs):
+    ref = ins['Input']
+    shape = list(attrs['shape'])
+    shape[attrs.get('output_dim_idx', 0)] = \
+        ref.shape[attrs.get('input_dim_idx', 0)]
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    key = ctx.rng()
+    return {'Out': jax.random.uniform(
+        key, shape, dtype=jnp.float32,
+        minval=attrs.get('min', -1.0),
+        maxval=attrs.get('max', 1.0)).astype(dtype)}
+
+
+@register('gaussian_random_batch_size_like')
+def gaussian_random_batch_size_like(ctx, ins, attrs):
+    ref = ins['Input']
+    shape = list(attrs['shape'])
+    shape[attrs.get('output_dim_idx', 0)] = \
+        ref.shape[attrs.get('input_dim_idx', 0)]
+    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    key = ctx.rng()
+    out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
+        jax.random.normal(key, shape, dtype=jnp.float32)
+    return {'Out': out.astype(dtype)}
+
+
+@register('print')
+def print_op(ctx, ins, attrs):
+    import jax
+    x = ins['X']
+    jax.debug.print(attrs.get('message', '') + ' {}', x)
+    return {'Out': x}
+
+
+@register('is_empty')
+def is_empty_op(ctx, ins, attrs):
+    return {'Out': jnp.asarray(ins['X'].size == 0)}

@@ -1,0 +1,14 @@
+"""`import paddle_tpu.paddle_compat as paddle` — the reference's top-level
+`paddle` namespace (batch, reader, dataset) so benchmark/book model code
+runs with two import-line changes only.
+"""
+import sys as _sys
+
+from .batch import batch  # noqa
+from . import reader  # noqa
+from . import dataset  # noqa
+from . import __init__ as _pkg
+
+fluid = _sys.modules['paddle_tpu']
+
+__all__ = ['batch', 'reader', 'dataset', 'fluid']

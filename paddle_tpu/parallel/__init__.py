@@ -1,0 +1,10 @@
+"""Distributed / parallel execution over jax.sharding meshes.
+
+Replaces reference paddle/fluid/framework/details (multi-GPU SSA graph +
+NCCL all-reduce) and transpiler/distribute_transpiler.py (pserver & NCCL2
+modes): data/tensor/pipeline/sequence parallelism are expressed as sharding
+annotations over a `jax.sharding.Mesh`; XLA GSPMD inserts the collectives
+(all-reduce/all-gather/reduce-scatter) over ICI.
+"""
+from .mesh import make_mesh, default_mesh, set_default_mesh  # noqa
+from .parallel_executor import ParallelExecutor  # noqa

@@ -1,0 +1,41 @@
+"""ParallelExecutor — data-parallel training over the device mesh.
+
+Parity: reference python/paddle/fluid/parallel_executor.py + C++
+framework/details/ SSA-graph executor.  The reference clones the graph per
+GPU and threads NCCL all_reduce ops between them; here the SAME lowered
+XLA computation runs SPMD: feeds are sharded on the batch dim over the
+'data' mesh axis, parameters are replicated, and GSPMD emits gradient
+all-reduces over ICI automatically.  `exe.run()` is still one device launch.
+"""
+import numpy as np
+
+from ..core.executor import Executor, global_scope
+from ..core.framework import default_main_program
+from .mesh import make_mesh
+
+__all__ = ['ParallelExecutor']
+
+
+class ParallelExecutor(object):
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None, mesh=None):
+        self._main_program = main_program or default_main_program()
+        self._loss_name = loss_name
+        self._scope = scope or global_scope()
+        import jax
+        self._mesh = mesh or make_mesh(data=len(jax.devices()))
+        self._exe = Executor(mesh=self._mesh)
+        if share_vars_from is not None:
+            self._scope = share_vars_from._scope
+
+    @property
+    def device_count(self):
+        return int(np.prod(self._mesh.devices.shape))
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        return self._exe.run(self._main_program, feed=feed,
+                             fetch_list=list(fetch_list),
+                             scope=self._scope, return_numpy=return_numpy)

@@ -1,0 +1,53 @@
+"""Profiler (parity: python/paddle/fluid/profiler.py) over jax.profiler.
+
+cuda_profiler/profiler/start_profiler map to XLA trace capture; traces are
+viewable in TensorBoard / Perfetto (xplane), replacing the reference's
+nvprof/chrome-tracing output.
+"""
+import contextlib
+import time
+
+__all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
+           'stop_profiler']
+
+_trace_dir = ['/tmp/paddle_tpu_profile']
+_active = [False]
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    with profiler('All', output_file=output_file):
+        yield
+
+
+def reset_profiler():
+    pass
+
+
+def start_profiler(state='All', tracer_option=None):
+    import jax
+    if not _active[0]:
+        jax.profiler.start_trace(_trace_dir[0])
+        _active[0] = True
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    import jax
+    if _active[0]:
+        jax.profiler.stop_trace()
+        _active[0] = False
+        print('[paddle_tpu.profiler] trace written to %s' % _trace_dir[0])
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path=None,
+             output_file=None):
+    if profile_path or output_file:
+        _trace_dir[0] = profile_path or output_file
+    start_profiler(state)
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+        print('[paddle_tpu.profiler] wall %.3fs' % (time.time() - t0))

@@ -1,0 +1,124 @@
+"""Reader decorators (parity: reference python/paddle/reader/decorator.py)."""
+import itertools
+import random
+from queue import Queue
+from threading import Thread
+
+__all__ = ['map_readers', 'buffered', 'compose', 'chain', 'shuffle',
+           'firstn', 'xmap_readers', 'multiprocess_reader', 'cache']
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum((make_tuple(o) for o in outputs if o is not None),
+                          ())
+    return reader
+
+
+def buffered(reader, size):
+    class EndSignal(object):
+        pass
+    end = EndSignal()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    # thread-pool mapper (the reference uses threads too)
+    def data_reader():
+        import concurrent.futures as cf
+        with cf.ThreadPoolExecutor(process_num) as pool:
+            it = reader()
+            pending = []
+            for sample in it:
+                pending.append(pool.submit(mapper, sample))
+                if len(pending) >= buffer_size:
+                    yield pending.pop(0).result()
+            for f in pending:
+                yield f.result()
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    # single-process fallback: chain (zero-egress sandboxed env)
+    return chain(*readers)
+
+
+def cache(reader):
+    all_data = []
+
+    def __impl__():
+        if not all_data:
+            for d in reader():
+                all_data.append(d)
+        for d in all_data:
+            yield d
+    return __impl__

@@ -1,0 +1,104 @@
+"""Transpilers (parity: python/paddle/fluid/transpiler/).
+
+DistributeTranspiler keeps the reference API (transpile with trainer_id /
+pservers / trainers, modes) but lowers to the TPU-collective world: trainers
+are SPMD processes over a jax mesh (jax.distributed), gradients all-reduce
+over ICI/DCN via GSPMD — there are no parameter servers.  `pserver` mode is
+accepted and mapped to collective mode with a warning (the legacy go/
+pserver in the reference is obsolete on TPU).
+"""
+import warnings
+
+from .core.framework import default_main_program
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
+           'memory_optimize', 'release_memory', 'InferenceTranspiler',
+           'HashName', 'RoundRobin']
+
+
+class DistributeTranspilerConfig(object):
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    sync_mode = True
+    mode = 'tpu_collective'
+
+
+class HashName(object):
+    def __init__(self, pserver_endpoints):
+        self._eps = pserver_endpoints
+
+
+RoundRobin = HashName
+
+
+class DistributeTranspiler(object):
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_id = 0
+        self._trainers = 1
+        self._program = None
+
+    def transpile(self, trainer_id, program=None, pservers='', trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=''):
+        """Annotate `program` for SPMD data-parallel execution.
+
+        trainers may be an int (process count) or a comma/`\\n`-separated
+        endpoint list (NCCL2-mode convention in the reference)."""
+        program = program or default_main_program()
+        if isinstance(trainers, str):
+            eps = trainers.replace('\n', ',').split(',')
+            trainers = len([e for e in eps if e])
+        self._trainer_id = trainer_id
+        self._trainers = trainers
+        self._program = program
+        if pservers:
+            warnings.warn(
+                'pserver mode is obsolete on TPU; mapping to tpu_collective '
+                '(SPMD + GSPMD all-reduce over ICI).')
+        # mark every data var as batch-sharded over the 'data' mesh axis
+        from jax.sharding import PartitionSpec as P
+        for v in program.global_block().vars.values():
+            if v.is_data:
+                program._sharding.setdefault(v.name, P('data'))
+        program._dist_info = {'trainer_id': trainer_id,
+                              'num_trainers': trainers,
+                              'mode': self.config.mode}
+        return program
+
+    def get_trainer_program(self, wait_port=True):
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        raise RuntimeError(
+            'no parameter servers on TPU: all trainers are SPMD peers. '
+            'Launch the same trainer program on every host '
+            '(jax.distributed.initialize).')
+
+    get_pserver_programs = get_pserver_program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        from .core.framework import default_startup_program
+        return startup_program or default_startup_program()
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """No-op: XLA's buffer assignment already performs liveness-based reuse
+    (the reference rewrites var names to share buffers; see
+    memory_optimization_transpiler.py).  Use paddle_tpu.recompute for
+    activation rematerialization."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None
+
+
+class InferenceTranspiler(object):
+    """No-op shim: BN folding / conv+bias fusion are XLA fusions."""
+
+    def transpile(self, program, place, scope=None):
+        return program
