@@ -236,21 +236,13 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
 
         def shard_of(name, default=P()):
             return NamedSharding(mesh, spec.get(name, default))
-        in_shardings = (
+        # feeds default to batch-sharding over the 'data' axis if present
+        feed_default = P('data') if 'data' in mesh.axis_names else P()
+        jit_kwargs['in_shardings'] = (
             {n: shard_of(n) for n in params_in},
-            {n: shard_of(n, P(*([None] if False else [])))
-             for n in feed_names},
+            {n: shard_of(n, feed_default) for n in feed_names},
             NamedSharding(mesh, P()),
         )
-        # feeds: shard batch dim over 'data' axis if present in mesh
-        data_axes = [ax for ax in ('data',) if ax in mesh.axis_names]
-        if data_axes:
-            in_shardings = (
-                {n: shard_of(n) for n in params_in},
-                {n: shard_of(n, P('data')) for n in feed_names},
-                NamedSharding(mesh, P()),
-            )
-        jit_kwargs['in_shardings'] = in_shardings
     return jax.jit(run_fn, **jit_kwargs), params_in, writeback
 
 
@@ -292,13 +284,24 @@ class Executor(object):
         feed_vals = {}
         for k, v in feed.items():
             if not block.has_var(k):
-                continue
+                raise KeyError(
+                    'feed var "%s" is not a variable of this program; '
+                    'data vars: %s' % (k, sorted(
+                        n for n, var in block.vars.items() if var.is_data)))
             from .lod import LoDTensor
             if isinstance(v, LoDTensor):
                 feed_vals[k] = v.padded
                 feed_vals[k + '@LENGTH'] = v.lengths
             else:
                 feed_vals[k] = np.asarray(v)
+        # lod vars fed as plain dense arrays: synthesize full lengths
+        for k in list(feed_vals.keys()):
+            lname = k + '@LENGTH'
+            if block.has_var(k) and block.var(k).lod_level > 0 and \
+                    lname not in feed_vals and block.has_var(lname):
+                arr = feed_vals[k]
+                feed_vals[lname] = np.full((arr.shape[0],), arr.shape[1],
+                                           dtype=np.int32)
         feed_names = tuple(sorted(feed_vals.keys()))
         fetch_names = tuple(self._resolve_fetch(fetch_list))
 
@@ -306,11 +309,13 @@ class Executor(object):
                id(scope))
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
+            # the cached tuple keeps a strong ref to `program` so its id()
+            # (part of the key) can never be recycled by a new Program
             entry = _lower(program, feed_names, fetch_names,
-                           donate=True, mesh=self.mesh)
+                           donate=True, mesh=self.mesh) + (program,)
             if use_program_cache:
                 self._cache[key] = entry
-        fn, params_in, writeback = entry
+        fn, params_in, writeback = entry[:3]
 
         params = {}
         for n in params_in:

@@ -258,6 +258,9 @@ class Variable(object):
     def __pow__(self, o):
         return self._binary(o, 'elementwise_pow')
 
+    def __rpow__(self, o):
+        return self._binary(o, 'elementwise_pow', reverse=True)
+
     def __neg__(self):
         return self._scale(-1.0, 0.0)
 

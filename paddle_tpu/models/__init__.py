@@ -1,0 +1,17 @@
+"""Model zoo (parity: reference benchmark/fluid/models/ + book chapters).
+
+Every model is built from paddle_tpu.layers graph code (same style as the
+reference's fluid model code) and exposes:
+    build(...) -> dict with 'loss', 'feeds', optional 'accuracy'/'fetches'
+plus a reference-style `get_model(args, is_train, main_prog, startup_prog)`
+where it makes sense.
+"""
+from . import mnist  # noqa
+from . import resnet  # noqa
+from . import vgg  # noqa
+from . import se_resnext  # noqa
+from . import stacked_lstm  # noqa
+from . import transformer  # noqa
+from . import ctr  # noqa
+from . import word2vec  # noqa
+from . import simple  # noqa

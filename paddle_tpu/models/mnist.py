@@ -1,0 +1,43 @@
+"""MNIST CNN (parity: reference benchmark/fluid/models/mnist.py
+cnn_model/get_model)."""
+import paddle_tpu as fluid
+
+
+def cnn_model(data):
+    conv_pool_1 = fluid.nets.simple_img_conv_pool(
+        input=data, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act='relu')
+    conv_pool_2 = fluid.nets.simple_img_conv_pool(
+        input=conv_pool_1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act='relu')
+    predict = fluid.layers.fc(input=conv_pool_2, size=10, act='softmax')
+    return predict
+
+
+def build(batch_size=None, lr=0.001, is_train=True):
+    images = fluid.layers.data(name='pixel', shape=[1, 28, 28],
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    predict = cnn_model(images)
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(cost)
+    batch_acc = fluid.layers.accuracy(input=predict, label=label)
+    opt = None
+    if is_train:
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=lr)
+        opt.minimize(avg_cost)
+    return {'loss': avg_cost, 'accuracy': batch_acc,
+            'feeds': [images, label], 'predict': predict, 'optimizer': opt}
+
+
+def get_model(args, is_train, main_prog, startup_prog):
+    """Reference-style entry (benchmark/fluid/models/mnist.py:get_model)."""
+    import paddle_tpu.dataset.mnist as mnist_data
+    from paddle_tpu.batch import batch as batch_fn
+    with fluid.program_guard(main_prog, startup_prog):
+        with fluid.unique_name.guard():
+            out = build(lr=0.001, is_train=is_train)
+    reader = mnist_data.train() if is_train else mnist_data.test()
+    batched = batch_fn(reader, args.batch_size if hasattr(
+        args, 'batch_size') else 64)
+    return (out['loss'], out['optimizer'], [out['accuracy']], batched, None)

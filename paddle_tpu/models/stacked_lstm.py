@@ -1,0 +1,45 @@
+"""Stacked dynamic-LSTM sentiment LM (parity: reference
+benchmark/fluid/models/stacked_dynamic_lstm.py).
+
+Ragged IMDB reviews feed as padded+lengths LoDTensors; each LSTM layer is a
+lax.scan recurrence with per-step masking (ops/sequence.py lstm).
+"""
+import paddle_tpu as fluid
+
+
+def lstm_net(data, dict_dim, emb_dim=512, hid_dim=512, stacked_num=3,
+             class_dim=2):
+    emb = fluid.layers.embedding(input=data, size=[dict_dim, emb_dim])
+    fc1 = fluid.layers.fc(input=emb, size=hid_dim * 4)
+    lstm1, _ = fluid.layers.dynamic_lstm(input=fc1, size=hid_dim * 4,
+                                         use_peepholes=False)
+    inputs = [fc1, lstm1]
+    for i in range(2, stacked_num + 1):
+        fc = fluid.layers.fc(input=inputs, size=hid_dim * 4)
+        lstm, _ = fluid.layers.dynamic_lstm(
+            input=fc, size=hid_dim * 4, is_reverse=(i % 2) == 0,
+            use_peepholes=False)
+        inputs = [fc, lstm]
+    fc_last = fluid.layers.sequence_pool(input=inputs[0], pool_type='max')
+    lstm_last = fluid.layers.sequence_pool(input=inputs[1], pool_type='max')
+    prediction = fluid.layers.fc(input=[fc_last, lstm_last], size=class_dim,
+                                 act='softmax')
+    return prediction
+
+
+def build(dict_dim=5147, emb_dim=512, hid_dim=512, stacked_num=3,
+          class_dim=2, lr=0.002, is_train=True):
+    data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                             lod_level=1)
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    prediction = lstm_net(data, dict_dim, emb_dim, hid_dim, stacked_num,
+                          class_dim)
+    cost = fluid.layers.cross_entropy(input=prediction, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    batch_acc = fluid.layers.accuracy(input=prediction, label=label)
+    opt = None
+    if is_train:
+        opt = fluid.optimizer.Adam(learning_rate=lr)
+        opt.minimize(avg_cost)
+    return {'loss': avg_cost, 'accuracy': batch_acc,
+            'feeds': [data, label], 'predict': prediction, 'optimizer': opt}

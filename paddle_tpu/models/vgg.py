@@ -1,0 +1,42 @@
+"""VGG-16 (parity: reference benchmark/fluid/models/vgg.py)."""
+import paddle_tpu as fluid
+
+
+def vgg16_bn_drop(input, is_train=True):
+    def conv_block(inp, num_filter, groups, dropouts):
+        return fluid.nets.img_conv_group(
+            input=inp, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act='relu', conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts, pool_type='max')
+
+    conv1 = conv_block(input, 64, 2, [0.3, 0])
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0])
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0])
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0])
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0])
+
+    drop = fluid.layers.dropout(x=conv5, dropout_prob=0.5)
+    fc1 = fluid.layers.fc(input=drop, size=512, act=None)
+    bn = fluid.layers.batch_norm(input=fc1, act='relu',
+                                 is_test=not is_train)
+    drop2 = fluid.layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = fluid.layers.fc(input=drop2, size=512, act=None)
+    return fc2
+
+
+def build(data_shape=(3, 32, 32), class_dim=10, lr=1e-3, is_train=True):
+    images = fluid.layers.data(name='data', shape=list(data_shape),
+                               dtype='float32')
+    label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+    net = vgg16_bn_drop(images, is_train)
+    predict = fluid.layers.fc(input=net, size=class_dim, act='softmax')
+    cost = fluid.layers.cross_entropy(input=predict, label=label)
+    avg_cost = fluid.layers.mean(x=cost)
+    batch_acc = fluid.layers.accuracy(input=predict, label=label)
+    opt = None
+    if is_train:
+        opt = fluid.optimizer.Adam(learning_rate=lr)
+        opt.minimize(avg_cost)
+    return {'loss': avg_cost, 'accuracy': batch_acc,
+            'feeds': [images, label], 'predict': predict, 'optimizer': opt}

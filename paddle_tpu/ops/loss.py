@@ -42,12 +42,15 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
     if attrs.get('soft_label', False):
         loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
     else:
-        lab = _squeeze_label(label)
-        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32),
-                                     axis=axis)
+        # label keeps a size-1 dim at `axis` (reference convention); add it
+        # if the caller passed the squeezed form
+        lab = label
+        if lab.ndim == logp.ndim - 1:
+            lab = jnp.expand_dims(lab, axis)
+        picked = jnp.take_along_axis(logp, lab.astype(jnp.int32), axis=axis)
         loss = -picked
         ignore = attrs.get('ignore_index', -100)
-        loss = jnp.where(lab[..., None] == ignore, jnp.zeros_like(loss), loss)
+        loss = jnp.where(lab == ignore, jnp.zeros_like(loss), loss)
     return {'Loss': loss, 'Softmax': jnp.exp(logp)}
 
 

@@ -219,8 +219,11 @@ def scale(ctx, ins, attrs):
     b = attrs.get('bias', 0.0)
     x = ins['X']
     if attrs.get('bias_after_scale', True):
-        return {'Out': x * s + jnp.asarray(b, x.dtype)}
-    return {'Out': (x + jnp.asarray(b, x.dtype)) * s}
+        out = x * s + jnp.asarray(b, x.dtype)
+    else:
+        out = (x + jnp.asarray(b, x.dtype)) * s
+    # parity with reference scale_op: dtype is preserved (int stays int)
+    return {'Out': out.astype(x.dtype)}
 
 
 @register('clip')

@@ -52,6 +52,19 @@ def conv3d(ctx, ins, attrs):
     return {'Output': out}
 
 
+def _transpose_filter(w, groups, spatial_axes):
+    """[in_c, out_c/g, *k] -> flipped [out_c, in_c/g, *k] for the
+    gradient-of-conv formulation (grouped: per-group O/I swap)."""
+    w = jnp.flip(w, spatial_axes)
+    if groups == 1:
+        return w.swapaxes(0, 1)
+    in_c, ocg = w.shape[0], w.shape[1]
+    k = w.shape[2:]
+    wg = w.reshape((groups, in_c // groups, ocg) + k)
+    wg = wg.swapaxes(1, 2)  # [g, out_c/g, in_c/g, *k]
+    return wg.reshape((groups * ocg, in_c // groups) + k)
+
+
 @register('conv2d_transpose')
 def conv2d_transpose(ctx, ins, attrs):
     x, w = ins['Input'], ins['Filter']  # w: [in_c, out_c/groups, kh, kw]
@@ -62,7 +75,7 @@ def conv2d_transpose(ctx, ins, attrs):
     kh, kw = w.shape[2], w.shape[3]
     # gradient-of-conv formulation: lhs_dilation = stride
     out = lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3)).swapaxes(0, 1) if groups == 1 else w,
+        x, _transpose_filter(w, groups, (2, 3)),
         window_strides=(1, 1),
         padding=[(dil[0] * (kh - 1) - pads[0], dil[0] * (kh - 1) - pads[0]),
                  (dil[1] * (kw - 1) - pads[1], dil[1] * (kw - 1) - pads[1])],
@@ -78,12 +91,14 @@ def conv3d_transpose(ctx, ins, attrs):
     strides = _pair(attrs.get('strides', [1, 1, 1]), 3)
     pads = _pair(attrs.get('paddings', [0, 0, 0]), 3)
     dil = _pair(attrs.get('dilations', [1, 1, 1]), 3)
+    groups = attrs.get('groups', 1) or 1
     ks = w.shape[2:]
     out = lax.conv_general_dilated(
-        x, jnp.flip(w, (2, 3, 4)).swapaxes(0, 1),
+        x, _transpose_filter(w, groups, (2, 3, 4)),
         window_strides=(1, 1, 1),
         padding=[(dil[i] * (ks[i] - 1) - pads[i],) * 2 for i in range(3)],
         lhs_dilation=strides, rhs_dilation=dil,
+        feature_group_count=groups,
         dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
     return {'Output': out}
 
@@ -296,9 +311,36 @@ def l2_norm_layer(ctx, ins, attrs):
 
 def _resize(x, out_h, out_w, method, align_corners):
     n, c, h, w = x.shape
-    xt = x.transpose(0, 2, 3, 1)
-    out = jax.image.resize(xt, (n, out_h, out_w, c), method=method)
-    return out.transpose(0, 3, 1, 2)
+    if not align_corners:
+        xt = x.transpose(0, 2, 3, 1)
+        out = jax.image.resize(xt, (n, out_h, out_w, c), method=method)
+        return out.transpose(0, 3, 1, 2)
+
+    # align_corners=True (the reference default): src = i*(in-1)/(out-1)
+    def coords(out_size, in_size):
+        if out_size == 1:
+            return jnp.zeros((1,))
+        return jnp.arange(out_size) * ((in_size - 1) / (out_size - 1))
+
+    ys = coords(out_h, h)
+    xs = coords(out_w, w)
+    if method == 'nearest':
+        yi = jnp.round(ys).astype(jnp.int32)
+        xi = jnp.round(xs).astype(jnp.int32)
+        return x[:, :, yi][:, :, :, xi]
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).reshape(1, 1, -1, 1).astype(x.dtype)
+    wx = (xs - x0).reshape(1, 1, 1, -1).astype(x.dtype)
+    tl = x[:, :, y0][:, :, :, x0]
+    tr = x[:, :, y0][:, :, :, x1]
+    bl = x[:, :, y1][:, :, :, x0]
+    br = x[:, :, y1][:, :, :, x1]
+    top = tl * (1 - wx) + tr * wx
+    bot = bl * (1 - wx) + br * wx
+    return top * (1 - wy) + bot * wy
 
 
 @register('bilinear_interp')

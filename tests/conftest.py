@@ -1,0 +1,37 @@
+"""Test config: force CPU backend with 8 virtual devices BEFORE jax import,
+so multi-chip sharding paths are exercised without TPU hardware."""
+import os
+
+os.environ['JAX_PLATFORMS'] = 'cpu'  # override axon/tpu from the outer env
+flags = os.environ.get('XLA_FLAGS', '')
+if 'xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+# pytest plugins (jaxtyping) import jax before this conftest runs, so the
+# env var alone is too late — force the config directly.
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs + scope + name generator."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework, unique_name
+    from paddle_tpu.core import executor as executor_mod
+    main, startup = framework.Program(), framework.Program()
+    old_main = framework.switch_main_program(main)
+    old_startup = framework.switch_startup_program(startup)
+    old_gen = unique_name.switch()
+    old_scope = executor_mod._global_scope
+    executor_mod._global_scope = executor_mod.Scope()
+    yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    unique_name.switch(old_gen)
+    executor_mod._global_scope = old_scope

@@ -1,0 +1,83 @@
+"""append_backward / gradients tests (model: reference
+tests/unittests/test_backward.py + per-op grad checks via numeric diff)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _numeric_grad(f, x, eps=1e-3):
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=['multi_index'])
+    while not it.finished:
+        idx = it.multi_index
+        xp = x.copy()
+        xp[idx] += eps
+        xm = x.copy()
+        xm[idx] -= eps
+        g[idx] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def test_fc_grad_matches_numeric():
+    x = fluid.layers.data('x', shape=[3], dtype='float32')
+    y = fluid.layers.fc(x, 2, param_attr='w_fc', bias_attr='b_fc')
+    loss = fluid.layers.mean(fluid.layers.square(y))
+    pg = fluid.append_backward(loss)
+    names = {p.name for p, g in pg}
+    assert names == {'w_fc', 'b_fc'}
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(0).normal(size=(4, 3)).astype('float32')
+    gw, = exe.run(feed={'x': xv}, fetch_list=['w_fc@GRAD'])
+    w0 = np.array(fluid.global_scope().get('w_fc'))
+    b0 = np.array(fluid.global_scope().get('b_fc'))
+
+    def f(w):
+        return np.mean(np.square(xv @ w + b0))
+    gn = _numeric_grad(f, w0.astype('float64')).astype('float32')
+    np.testing.assert_allclose(gw, gn, rtol=1e-2, atol=1e-3)
+
+
+def test_stop_gradient_blocks_flow():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    w = fluid.layers.create_parameter(
+        [2], 'float32', name='w_sg',
+        default_initializer=fluid.initializer.Constant(2.0))
+    h = fluid.layers.elementwise_mul(x, w)
+    h.stop_gradient = True
+    h2 = fluid.layers.scale(h, 3.0)
+    loss = fluid.layers.mean(h2)
+    fluid.append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    g, = exe.run(feed={'x': np.ones((1, 2), 'float32')},
+                 fetch_list=['w_sg@GRAD'])
+    np.testing.assert_allclose(g, np.zeros(2), atol=1e-7)
+
+
+def test_gradients_wrt_input():
+    x = fluid.layers.data('x', shape=[3], dtype='float32')
+    x.stop_gradient = False
+    y = fluid.layers.mean(fluid.layers.square(x))
+    (gx,) = fluid.gradients(y, x)
+    exe = fluid.Executor()
+    xv = np.array([[1., 2., 3.]], 'float32')
+    out, = exe.run(feed={'x': xv}, fetch_list=[gx])
+    np.testing.assert_allclose(out, 2 * xv / 3, rtol=1e-5)
+
+
+def test_backward_through_conv_bn_pool():
+    img = fluid.layers.data('img', shape=[3, 8, 8], dtype='float32')
+    c = fluid.layers.conv2d(img, 4, 3, act='relu')
+    b = fluid.layers.batch_norm(c)
+    p = fluid.layers.pool2d(b, 2, pool_stride=2, pool_type='avg')
+    loss = fluid.layers.mean(p)
+    pg = fluid.append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fetches = [g for p_, g in pg]
+    outs = exe.run(feed={'img': np.random.RandomState(1).normal(
+        size=(2, 3, 8, 8)).astype('float32')}, fetch_list=fetches)
+    for o in outs:
+        assert np.all(np.isfinite(o))

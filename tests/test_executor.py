@@ -1,0 +1,96 @@
+"""Executor + framework core tests (model: reference
+tests/unittests/test_executor_and_mul.py, test_program.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_feed_fetch_identity():
+    x = fluid.layers.data('x', shape=[4], dtype='float32')
+    y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+    exe = fluid.Executor()
+    xv = np.arange(8, dtype='float32').reshape(2, 4)
+    out, = exe.run(feed={'x': xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+
+def test_shape_inference_batch_dim():
+    x = fluid.layers.data('x', shape=[1, 28, 28], dtype='float32')
+    y = fluid.layers.fc(x, 10)
+    assert y.shape == (-1, 10)
+    c = fluid.layers.conv2d(x, 6, 5)
+    assert c.shape == (-1, 6, 24, 24)
+    p = fluid.layers.pool2d(c, 2, pool_stride=2)
+    assert p.shape == (-1, 6, 12, 12)
+
+
+def test_program_guard_and_clone():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[3], dtype='float32')
+        d = fluid.layers.dropout(fluid.layers.fc(x, 4), 0.5)
+        loss = fluid.layers.mean(d)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    n_train_ops = len(main.global_block().ops)
+    test_prog = main.clone(for_test=True)
+    n_test_ops = len(test_prog.global_block().ops)
+    assert n_test_ops < n_train_ops
+    drop_ops = [op for op in test_prog.global_block().ops
+                if op.type == 'dropout']
+    assert drop_ops and drop_ops[0].attrs['is_test'] is True
+
+
+def test_persistable_update_and_scope():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    w = fluid.layers.create_parameter([2, 2], 'float32', name='w_test',
+                                      default_initializer=
+                                      fluid.initializer.Constant(1.0))
+    y = fluid.layers.mul(x, w)
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(1.0).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    w0 = np.array(fluid.global_scope().get('w_test'))
+    np.testing.assert_allclose(w0, np.ones((2, 2)), rtol=1e-6)
+    exe.run(feed={'x': np.ones((4, 2), 'float32')}, fetch_list=[loss])
+    w1 = np.array(fluid.global_scope().get('w_test'))
+    assert not np.allclose(w0, w1)
+
+
+def test_uninitialized_param_error():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    y = fluid.layers.fc(x, 2)
+    exe = fluid.Executor()
+    with pytest.raises(RuntimeError, match='startup'):
+        exe.run(feed={'x': np.ones((1, 2), 'float32')}, fetch_list=[y])
+
+
+def test_math_op_patch():
+    x = fluid.layers.data('x', shape=[3], dtype='float32')
+    y = (x * 2.0 + 1.0) / 2.0 - 0.5
+    z = -y
+    exe = fluid.Executor()
+    xv = np.array([[1., 2., 3.]], 'float32')
+    out, = exe.run(feed={'x': xv}, fetch_list=[z])
+    np.testing.assert_allclose(out, -xv, rtol=1e-6)
+
+
+def test_run_default_program_cache():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    y = fluid.layers.scale(x, scale=3.0)
+    exe = fluid.Executor()
+    for i in range(3):
+        out, = exe.run(feed={'x': np.full((2, 2), i, 'float32')},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, np.full((2, 2), 3.0 * i), rtol=1e-6)
+
+
+def test_fetch_param_directly():
+    fluid.layers.create_parameter([3], 'float32', name='pp',
+                                  default_initializer=
+                                  fluid.initializer.Constant(2.5))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    out, = exe.run(fetch_list=['pp'])
+    np.testing.assert_allclose(out, [2.5] * 3, rtol=1e-6)

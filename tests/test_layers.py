@@ -1,0 +1,225 @@
+"""Broad layer coverage: every layer builds into a program and executes
+(model: reference tests/unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_activations_and_elementwise():
+    x = layers.data('x', shape=[4], dtype='float32')
+    outs = [layers.relu(x), layers.sigmoid(x), layers.tanh(x),
+            layers.leaky_relu(x), layers.elu(x), layers.softplus(x),
+            layers.square(x), layers.abs(x), layers.exp(x),
+            layers.swish(x), layers.hard_sigmoid(x),
+            layers.elementwise_add(x, x), layers.elementwise_max(x, x),
+            layers.scale(x, 2.0), layers.clip(x, -0.5, 0.5)]
+    xv = np.linspace(-2, 2, 8).reshape(2, 4).astype('float32')
+    res = _run(outs, {'x': xv})
+    np.testing.assert_allclose(res[0], np.maximum(xv, 0), rtol=1e-6)
+    np.testing.assert_allclose(res[6], xv * xv, rtol=1e-6)
+    np.testing.assert_allclose(res[11], 2 * xv, rtol=1e-6)
+
+
+def test_reductions_and_reshape():
+    x = layers.data('x', shape=[2, 3], dtype='float32')
+    outs = [layers.reduce_sum(x, dim=1), layers.reduce_mean(x),
+            layers.reduce_max(x, dim=2, keep_dim=True),
+            layers.reshape(x, [-1, 6]), layers.transpose(x, [0, 2, 1]),
+            layers.flatten(x), layers.squeeze(layers.unsqueeze(x, [1]),
+                                              [1])]
+    xv = np.arange(12).reshape(2, 2, 3).astype('float32')
+    res = _run(outs, {'x': xv})
+    np.testing.assert_allclose(res[0], xv.sum(1), rtol=1e-6)
+    np.testing.assert_allclose(res[3], xv.reshape(2, 6), rtol=1e-6)
+    np.testing.assert_allclose(res[6], xv, rtol=1e-6)
+
+
+def test_concat_split_stack_gather():
+    x = layers.data('x', shape=[4], dtype='float32')
+    y = layers.data('y', shape=[4], dtype='float32')
+    cat = layers.concat([x, y], axis=1)
+    parts = layers.split(cat, 2, dim=1)
+    st = layers.stack([x, y], axis=1)
+    idx = layers.data('idx', shape=[], dtype='int32',
+                      append_batch_size=False)
+    xv = np.ones((2, 4), 'float32')
+    yv = np.zeros((2, 4), 'float32')
+    res = _run([cat, parts[0], st], {'x': xv, 'y': yv})
+    assert res[0].shape == (2, 8)
+    np.testing.assert_allclose(res[1], xv)
+    assert res[2].shape == (2, 2, 4)
+
+
+def test_conv_pool_norm_shapes():
+    img = layers.data('img', shape=[3, 16, 16], dtype='float32')
+    c = layers.conv2d(img, 8, 3, padding=1)
+    assert c.shape == (-1, 8, 16, 16)
+    ct = layers.conv2d_transpose(c, 3, filter_size=2, stride=2)
+    assert ct.shape == (-1, 3, 32, 32)
+    p = layers.pool2d(c, 2, pool_stride=2, pool_type='avg')
+    assert p.shape == (-1, 8, 8, 8)
+    ap = layers.adaptive_pool2d(c, 4, pool_type='avg')
+    assert ap.shape == (-1, 8, 4, 4)
+    g = layers.group_norm(c, groups=2)
+    ln = layers.layer_norm(c)
+    res = _run([c, ct, p, ap, g, ln],
+               {'img': np.random.rand(2, 3, 16, 16).astype('float32')})
+    for r in res:
+        assert np.all(np.isfinite(r))
+
+
+def test_losses():
+    logit = layers.data('logit', shape=[5], dtype='float32')
+    label = layers.data('label', shape=[1], dtype='int64')
+    flabel = layers.data('flabel', shape=[5], dtype='float32')
+    sm = layers.softmax(logit)
+    ce = layers.cross_entropy(sm, label)
+    swce = layers.softmax_with_cross_entropy(logit, label)
+    sig = layers.sigmoid_cross_entropy_with_logits(logit, flabel)
+    sq = layers.square_error_cost(logit, flabel)
+    lv = np.random.RandomState(0).normal(size=(3, 5)).astype('float32')
+    lab = np.array([[0], [2], [4]], 'int64')
+    flab = np.random.RandomState(1).uniform(size=(3, 5)).astype('float32')
+    res = _run([ce, swce, sig, sq],
+               {'logit': lv, 'label': lab, 'flabel': flab})
+    np.testing.assert_allclose(res[0], res[1], rtol=1e-5)
+    expect_sq = (lv - flab) ** 2
+    np.testing.assert_allclose(res[3], expect_sq, rtol=1e-5)
+
+
+def test_embedding_and_one_hot():
+    ids = layers.data('ids', shape=[1], dtype='int64')
+    emb = layers.embedding(ids, size=[10, 4])
+    oh = layers.one_hot(ids, 10)
+    res = _run([emb, oh], {'ids': np.array([[1], [3]], 'int64')})
+    assert res[0].shape == (2, 4)
+    assert res[1].shape == (2, 10)
+    assert res[1][0, 1] == 1.0 and res[1][1, 3] == 1.0
+
+
+def test_topk_argmax_argsort():
+    x = layers.data('x', shape=[5], dtype='float32')
+    vals, idxs = layers.topk(x, 2)
+    am = layers.argmax(x, axis=1)
+    srt, sidx = layers.argsort(x, axis=1)
+    xv = np.array([[3., 1., 4., 1., 5.]], 'float32')
+    res = _run([vals, idxs, am, srt], {'x': xv})
+    np.testing.assert_allclose(res[0], [[5., 4.]])
+    assert res[2][0] == 4
+    np.testing.assert_allclose(res[3][0], np.sort(xv[0]))
+
+
+def test_dropout_train_vs_test():
+    x = layers.data('x', shape=[100], dtype='float32')
+    d_train = layers.dropout(x, 0.5)
+    d_test = layers.dropout(x, 0.5, is_test=True)
+    xv = np.ones((4, 100), 'float32')
+    res = _run([d_train, d_test], {'x': xv})
+    assert (res[0] == 0).mean() > 0.2          # some dropped
+    np.testing.assert_allclose(res[1], xv * 0.5, rtol=1e-6)
+
+
+def test_batch_norm_moving_stats_update():
+    x = layers.data('x', shape=[4], dtype='float32')
+    bn = layers.batch_norm(x)
+    loss = layers.mean(bn)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    moving = [v for v in fluid.default_main_program().all_parameters()
+              if not v.trainable]
+    assert len(moving) == 2  # moving mean + variance
+    before = {v.name: np.asarray(fluid.global_scope().get(v.name))
+              for v in moving}
+    xv = np.random.RandomState(0).normal(3.0, 1.0, (64, 4)).astype('float32')
+    exe.run(feed={'x': xv}, fetch_list=[loss])
+    after = {v.name: np.asarray(fluid.global_scope().get(v.name))
+             for v in moving}
+    # momentum 0.9: moving mean steps 0 -> ~0.3 toward batch mean 3.0
+    assert any(np.abs(after[n] - before[n]).mean() > 0.05 for n in after)
+
+
+def test_matmul_variants():
+    a = layers.data('a', shape=[2, 3], dtype='float32')
+    b = layers.data('b', shape=[3, 2], dtype='float32')
+    mm = layers.matmul(a, b)
+    mt = layers.matmul(a, a, transpose_y=True)
+    av = np.random.rand(4, 2, 3).astype('float32')
+    bv = np.random.rand(4, 3, 2).astype('float32')
+    res = _run([mm, mt], {'a': av, 'b': bv})
+    np.testing.assert_allclose(res[0], av @ bv, rtol=1e-5)
+    np.testing.assert_allclose(res[1], av @ av.transpose(0, 2, 1),
+                               rtol=1e-5)
+
+
+def test_pad_and_label_smooth():
+    x = layers.data('x', shape=[2, 2], dtype='float32')
+    p = layers.pad(x, [0, 0, 1, 1, 0, 0], pad_value=9.0)
+    oh = layers.data('oh', shape=[4], dtype='float32')
+    ls = layers.label_smooth(oh, epsilon=0.1)
+    xv = np.ones((1, 2, 2), 'float32')
+    ohv = np.eye(4, dtype='float32')[:1].reshape(1, 4)
+    res = _run([p, ls], {'x': xv, 'oh': ohv})
+    assert res[0].shape == (1, 4, 2)
+    np.testing.assert_allclose(res[1][0][0], 0.9 + 0.1 / 4, rtol=1e-5)
+
+
+def test_where_like_ops_and_compare():
+    x = layers.data('x', shape=[3], dtype='float32')
+    y = layers.data('y', shape=[3], dtype='float32')
+    lt = layers.less_than(x, y)
+    eq = layers.equal(x, y)
+    land = layers.logical_and(lt, eq)
+    xv = np.array([[1., 2., 3.]], 'float32')
+    yv = np.array([[3., 2., 1.]], 'float32')
+    res = _run([lt, eq, land], {'x': xv, 'y': yv})
+    assert res[0].tolist() == [[True, False, False]]
+    assert res[1].tolist() == [[False, True, False]]
+    assert res[2].tolist() == [[False, False, False]]
+
+
+def test_nets_helpers():
+    img = layers.data('img', shape=[1, 8, 8], dtype='float32')
+    cp = fluid.nets.simple_img_conv_pool(img, 4, 3, 2, 2, act='relu')
+    g = fluid.nets.glu(layers.fc(cp, 8), dim=-1)
+    res = _run([cp, g], {'img': np.random.rand(2, 1, 8, 8)
+                         .astype('float32')})
+    assert res[0].shape == (2, 4, 3, 3)
+    assert res[1].shape == (2, 4)
+
+
+def test_lr_schedulers_build():
+    # each scheduler builds (own program: they share a step-counter var)
+    builders = [
+        lambda: layers.exponential_decay(0.1, 100, 0.9),
+        lambda: layers.natural_exp_decay(0.1, 100, 0.9),
+        lambda: layers.inverse_time_decay(0.1, 100, 0.9),
+        lambda: layers.polynomial_decay(0.1, 100),
+        lambda: layers.piecewise_decay([10, 20], [0.1, 0.05, 0.01]),
+        lambda: layers.noam_decay(64, 100),
+        lambda: layers.cosine_decay(0.1, 10, 100),
+    ]
+    for build in builders:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lr = build()
+            exe = fluid.Executor()
+            exe.run(startup)
+            out, = exe.run(main, fetch_list=[lr])
+            assert out.reshape(-1)[0] > 0
+
+
+def test_uniform_random_and_gaussian():
+    u = layers.uniform_random([4, 5], min=-2, max=2)
+    g = layers.gaussian_random([4, 5], std=2.0)
+    res = _run([u, g], {})
+    assert res[0].shape == (4, 5)
+    assert np.abs(res[0]).max() <= 2.0
+    assert res[1].std() > 0.3

@@ -1,0 +1,129 @@
+"""Optimizer update tests vs numpy reference (model: reference
+tests/unittests/test_optimizer.py + per-optimizer op tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _one_step(opt, lr=0.1, steps=1):
+    """Train y = mean(x*w) one/few steps; return (w_history, grad)."""
+    x = fluid.layers.data('x', shape=[4], dtype='float32')
+    w = fluid.layers.create_parameter(
+        [4], 'float32', name='w_opt',
+        default_initializer=fluid.initializer.Constant(1.0))
+    y = fluid.layers.elementwise_mul(x, w)
+    loss = fluid.layers.mean(y)
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xv = np.array([[1., 2., 3., 4.]], 'float32')
+    ws = [np.array(fluid.global_scope().get('w_opt'))]
+    for _ in range(steps):
+        exe.run(feed={'x': xv}, fetch_list=[loss])
+        ws.append(np.array(fluid.global_scope().get('w_opt')))
+    grad = xv[0] / 4.0
+    return ws, grad
+
+
+def test_sgd():
+    ws, g = _one_step(fluid.optimizer.SGD(0.1))
+    np.testing.assert_allclose(ws[1], ws[0] - 0.1 * g, rtol=1e-5)
+
+
+def test_momentum():
+    ws, g = _one_step(fluid.optimizer.Momentum(0.1, momentum=0.9), steps=2)
+    v1 = g
+    np.testing.assert_allclose(ws[1], ws[0] - 0.1 * v1, rtol=1e-5)
+    v2 = 0.9 * v1 + g
+    np.testing.assert_allclose(ws[2], ws[1] - 0.1 * v2, rtol=1e-5)
+
+
+def test_adam():
+    ws, g = _one_step(fluid.optimizer.Adam(0.1), steps=1)
+    m1 = 0.1 * g
+    m2 = 0.001 * g * g
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expect = ws[0] - lr_t * m1 / (np.sqrt(m2) + 1e-8)
+    np.testing.assert_allclose(ws[1], expect, rtol=1e-4)
+
+
+def test_adagrad():
+    ws, g = _one_step(fluid.optimizer.Adagrad(0.1))
+    expect = ws[0] - 0.1 * g / (np.sqrt(g * g) + 1e-6)
+    np.testing.assert_allclose(ws[1], expect, rtol=1e-4)
+
+
+def test_rmsprop():
+    ws, g = _one_step(fluid.optimizer.RMSPropOptimizer(0.1))
+    ms = 0.05 * g * g
+    expect = ws[0] - 0.1 * g / np.sqrt(ms + 1e-6)
+    np.testing.assert_allclose(ws[1], expect, rtol=1e-4)
+
+
+@pytest.mark.parametrize('opt_ctor', [
+    lambda: fluid.optimizer.Adamax(0.01),
+    lambda: fluid.optimizer.DecayedAdagrad(0.01),
+    lambda: fluid.optimizer.Adadelta(0.01),
+    lambda: fluid.optimizer.Ftrl(0.01),
+    lambda: fluid.optimizer.LarsMomentum(0.01, momentum=0.9),
+])
+def test_all_optimizers_step(opt_ctor):
+    ws, _ = _one_step(opt_ctor(), steps=2)
+    assert not np.allclose(ws[0], ws[2])
+    assert np.all(np.isfinite(ws[2]))
+
+
+def test_regularization_l2():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    w = fluid.layers.create_parameter(
+        [2], 'float32', name='w_reg',
+        default_initializer=fluid.initializer.Constant(2.0))
+    loss = fluid.layers.mean(fluid.layers.elementwise_mul(x, w))
+    opt = fluid.optimizer.SGD(
+        0.1, regularization=fluid.regularizer.L2Decay(0.5))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={'x': np.zeros((1, 2), 'float32')}, fetch_list=[loss])
+    w1 = np.array(fluid.global_scope().get('w_reg'))
+    # grad = 0 + 0.5 * w -> w = 2 - 0.1*1.0 = 1.9
+    np.testing.assert_allclose(w1, [1.9, 1.9], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    w = fluid.layers.create_parameter(
+        [2], 'float32', name='w_clip',
+        default_initializer=fluid.initializer.Constant(1.0))
+    loss = fluid.layers.mean(fluid.layers.elementwise_mul(x, w) * 100.0)
+    fluid.clip.set_gradient_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+    fluid.optimizer.SGD(1.0).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={'x': np.ones((1, 2), 'float32')}, fetch_list=[loss])
+    w1 = np.array(fluid.global_scope().get('w_clip'))
+    # grad norm clipped to 1 -> step length <= 1
+    assert np.linalg.norm(1.0 - w1) <= 1.0 + 1e-4
+
+
+def test_lr_scheduler_decays():
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    w = fluid.layers.create_parameter(
+        [2], 'float32', name='w_lr',
+        default_initializer=fluid.initializer.Constant(1.0))
+    loss = fluid.layers.mean(fluid.layers.elementwise_mul(x, w))
+    lr = fluid.layers.exponential_decay(0.1, decay_steps=1, decay_rate=0.5)
+    fluid.optimizer.SGD(lr).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    deltas = []
+    prev = np.array(fluid.global_scope().get('w_lr'))
+    for _ in range(3):
+        exe.run(feed={'x': np.ones((1, 2), 'float32')}, fetch_list=[loss])
+        cur = np.array(fluid.global_scope().get('w_lr'))
+        deltas.append(np.abs(prev - cur).mean())
+        prev = cur
+    assert deltas[1] == pytest.approx(deltas[0] * 0.5, rel=1e-3)
+    assert deltas[2] == pytest.approx(deltas[1] * 0.5, rel=1e-3)

@@ -1,0 +1,162 @@
+"""Sequence (LoD) stack tests: padded+lengths representation, masked
+sequence ops, scan RNNs (model: reference sequence op unittests +
+test_dyn_rnn.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def _lod_feed():
+    rows = [np.array([[1.], [2.], [3.]], 'float32'),
+            np.array([[4.], [5.]], 'float32')]
+    return create_lod_tensor(rows)
+
+
+def test_create_lod_tensor_roundtrip():
+    t = _lod_feed()
+    assert t.padded.shape == (2, 3, 1)
+    assert t.lengths.tolist() == [3, 2]
+    np.testing.assert_allclose(t.flatten_rows().reshape(-1),
+                               [1, 2, 3, 4, 5])
+    # reference packed convention
+    t2 = create_lod_tensor(np.arange(5).reshape(5, 1), [[3, 2]], None)
+    assert t2.lengths.tolist() == [3, 2]
+
+
+def test_sequence_pool_masked():
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    pools = [layers.sequence_pool(x, t)
+             for t in ('sum', 'average', 'max', 'last', 'first', 'sqrt')]
+    exe = fluid.Executor()
+    res = exe.run(feed={'x': _lod_feed()}, fetch_list=pools)
+    np.testing.assert_allclose(res[0], [[6.], [9.]])          # sum
+    np.testing.assert_allclose(res[1], [[2.], [4.5]])          # avg
+    np.testing.assert_allclose(res[2], [[3.], [5.]])           # max
+    np.testing.assert_allclose(res[3], [[3.], [5.]])           # last
+    np.testing.assert_allclose(res[4], [[1.], [4.]])           # first
+    np.testing.assert_allclose(res[5], [[6 / np.sqrt(3)],
+                                        [9 / np.sqrt(2)]], rtol=1e-6)
+
+
+def test_sequence_softmax_ignores_pad():
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    sm = layers.sequence_softmax(x)
+    exe = fluid.Executor()
+    out, = exe.run(feed={'x': _lod_feed()}, fetch_list=[sm])
+    assert abs(out[0].sum() - 1.0) < 1e-5
+    assert abs(out[1, :2].sum() - 1.0) < 1e-5
+    assert out[1, 2, 0] == 0.0  # padded position zeroed
+
+
+def test_sequence_reverse_and_first_last():
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    rev = layers.sequence_reverse(x)
+    exe = fluid.Executor()
+    out, = exe.run(feed={'x': _lod_feed()}, fetch_list=[rev])
+    np.testing.assert_allclose(out[0, :3, 0], [3, 2, 1])
+    np.testing.assert_allclose(out[1, :2, 0], [5, 4])
+
+
+def test_sequence_expand():
+    x = layers.data('x', shape=[2], dtype='float32')
+    y = layers.data('y', shape=[1], dtype='float32', lod_level=1)
+    ex = layers.sequence_expand(x, y)
+    exe = fluid.Executor()
+    out, = exe.run(feed={'x': np.array([[1, 2], [3, 4]], 'float32'),
+                         'y': _lod_feed()}, fetch_list=[ex])
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(out[0, 0], [1, 2])
+    np.testing.assert_allclose(out[1, 1], [3, 4])
+
+
+def test_sequence_mask_layer():
+    lens = layers.data('lens', shape=[], dtype='int64')
+    m = layers.sequence_mask(lens, maxlen=5, dtype='float32')
+    exe = fluid.Executor()
+    out, = exe.run(feed={'lens': np.array([3, 5], 'int64')},
+                   fetch_list=[m])
+    np.testing.assert_allclose(out, [[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]])
+
+
+def test_dynamic_lstm_masked_equivalence():
+    """LSTM over padded batch == LSTM over each row alone (mask check)."""
+    dim = 4
+    x = layers.data('x', shape=[4 * dim], dtype='float32', lod_level=1)
+    h, c = layers.dynamic_lstm(x, size=4 * dim, use_peepholes=False)
+    last = layers.sequence_pool(h, 'last')
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    rows = [rng.normal(size=(3, 4 * dim)).astype('float32'),
+            rng.normal(size=(2, 4 * dim)).astype('float32')]
+    batched, = exe.run(feed={'x': create_lod_tensor(rows)},
+                       fetch_list=[last])
+    for i, row in enumerate(rows):
+        single, = exe.run(feed={'x': create_lod_tensor([row])},
+                          fetch_list=[last])
+        np.testing.assert_allclose(batched[i], single[0], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_dynamic_gru_runs_and_masks():
+    dim = 3
+    x = layers.data('x', shape=[3 * dim], dtype='float32', lod_level=1)
+    h = layers.dynamic_gru(x, size=dim)
+    pooled = layers.sequence_pool(h, 'last')
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    rows = [rng.normal(size=(4, 3 * dim)).astype('float32'),
+            rng.normal(size=(2, 3 * dim)).astype('float32')]
+    out, = exe.run(feed={'x': create_lod_tensor(rows)},
+                   fetch_list=[pooled])
+    assert out.shape == (2, dim)
+    assert np.all(np.isfinite(out))
+
+
+def test_sequence_conv_and_pad():
+    x = layers.data('x', shape=[4], dtype='float32', lod_level=1)
+    sc = layers.sequence_conv(x, num_filters=6, filter_size=3)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rows = [np.random.rand(5, 4).astype('float32'),
+            np.random.rand(2, 4).astype('float32')]
+    out, = exe.run(feed={'x': create_lod_tensor(rows)}, fetch_list=[sc])
+    assert out.shape == (2, 5, 6)
+    # padded tail rows must be zero (mask applied)
+    assert np.abs(out[1, 2:]).max() == 0.0
+
+
+def test_lstm_trains_sequence_classification():
+    """Tiny seq classification learns: first-token class signal."""
+    dim = 8
+    x = layers.data('x', shape=[4 * dim], dtype='float32', lod_level=1)
+    label = layers.data('label', shape=[1], dtype='int64')
+    h, _ = layers.dynamic_lstm(x, 4 * dim, use_peepholes=False)
+    pooled = layers.sequence_pool(h, 'max')
+    pred = layers.fc(pooled, 2, act='softmax')
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+
+    def make_batch(n=16):
+        rows, labels = [], []
+        for _ in range(n):
+            lab = rng.randint(2)
+            T = rng.randint(2, 6)
+            r = rng.normal(0, 0.3, (T, 4 * dim)).astype('float32')
+            r[:, 0] += (2.0 if lab else -2.0)
+            rows.append(r)
+            labels.append([lab])
+        return create_lod_tensor(rows), np.array(labels, 'int64')
+
+    losses = []
+    for i in range(60):
+        xv, yv = make_batch()
+        l, = exe.run(feed={'x': xv, 'label': yv}, fetch_list=[loss])
+        losses.append(float(l[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.8
