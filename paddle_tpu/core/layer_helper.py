@@ -66,7 +66,9 @@ class LayerHelper(object):
         if len(param_attr) != 1 and len(param_attr) != length:
             raise ValueError('parameter number mismatch')
         elif len(param_attr) == 1 and length != 1:
-            param_attr = param_attr * length
+            import copy
+            param_attr = [copy.deepcopy(param_attr[0])
+                          for _ in range(length)]
         return param_attr
 
     def input_dtype(self, input_param_name='input'):

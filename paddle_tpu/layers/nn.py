@@ -47,6 +47,7 @@ __all__ = [
     'sequence_reshape', 'sequence_scatter', 'sequence_mask',
     'sequence_enumerate', 'sequence_concat', 'sequence_reverse',
     'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'chunk_eval',
+    'flash_attention',
     'linear_chain_crf', 'crf_decoding', 'one_hot', 'group_norm',
     'teacher_student_sigmoid_loss',
 ]
@@ -1579,3 +1580,18 @@ def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
                    {'soft_max_up_bound': soft_max_up_bound,
                     'soft_max_lower_bound': soft_max_lower_bound},
                    outs=('Y',), extra_ins={'Label': label})
+
+
+def flash_attention(q, k, v, causal=False, k_lengths=None, name=None):
+    """Fused online-softmax attention over [B, H, T, D] tensors
+    (pallas kernel on TPU; see ops/attention.py).  New vs reference —
+    the reference composes matmul+softmax+matmul.  `k_lengths` (int [B])
+    masks suffix padding of K/V."""
+    helper = LayerHelper('flash_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    ins = {'Q': q, 'K': k, 'V': v}
+    if k_lengths is not None:
+        ins['KLength'] = k_lengths
+    helper.append_op(type='flash_attention', inputs=ins,
+                     outputs={'Out': out}, attrs={'causal': causal})
+    return out

@@ -16,15 +16,18 @@ from paddle_tpu.initializer import Normal
 
 
 def _linear(x, size, name, bias=True):
+    # Xavier init (the fluid fc default): keeps attention logits at O(1)
+    # scale so gradients reach the encoder from step 0
     return layers.fc(x, size, num_flatten_dims=2,
-                     param_attr=ParamAttr(name=name + '_w',
-                                          initializer=Normal(0., 0.02)),
+                     param_attr=ParamAttr(name=name + '_w'),
                      bias_attr=ParamAttr(name=name + '_b') if bias else False)
 
 
 def multi_head_attention(q_in, kv_in, mask, d_model, n_head, dropout,
-                         is_train, name, use_flash=False, causal=False):
-    """mask: [B, 1, Tq, Tk] additive (-1e9 on invalid)."""
+                         is_train, name, use_flash=False, causal=False,
+                         kv_lengths=None):
+    """mask: [B, 1, Tq, Tk] additive (-1e9 on invalid); kv_lengths int [B]
+    (used by the flash path, where pad is a suffix)."""
     d_head = d_model // n_head
     q = _linear(q_in, d_model, name + '_q', bias=False)
     k = _linear(kv_in, d_model, name + '_k', bias=False)
@@ -35,8 +38,17 @@ def multi_head_attention(q_in, kv_in, mask, d_model, n_head, dropout,
         return layers.transpose(x, perm=[0, 2, 1, 3])  # [B, H, T, Dh]
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    # the fused kernel has no attention-weight dropout: use it only when
+    # dropout is off (inference / LLM-style training); else compose ops
+    if use_flash and dropout and is_train:
+        use_flash = False
     if use_flash:
-        ctx = layers.flash_attention(q, k, v, causal=causal)
+        if mask is not None and kv_lengths is None:
+            raise ValueError(
+                'use_flash with a padding mask requires kv_lengths '
+                '(suffix-padding lengths); got None')
+        ctx = layers.flash_attention(q, k, v, causal=causal,
+                                     k_lengths=kv_lengths)
     else:
         q = layers.scale(q, scale=d_head ** -0.5)
         scores = layers.matmul(q, k, transpose_y=True)  # [B, H, Tq, Tk]
@@ -69,24 +81,27 @@ def _prenorm(x, sub, name):
     return layers.elementwise_add(x, sub(ln))
 
 
-def encoder_layer(x, mask, cfg, is_train, name):
+def encoder_layer(x, mask, cfg, is_train, name, lengths=None):
     x = _prenorm(x, lambda h: multi_head_attention(
         h, h, mask, cfg['d_model'], cfg['n_head'], cfg['dropout'], is_train,
-        name + '_att', cfg.get('use_flash', False)), name + '_att')
+        name + '_att', cfg.get('use_flash', False),
+        kv_lengths=lengths), name + '_att')
     x = _prenorm(x, lambda h: ffn(
         h, cfg['d_model'], cfg['d_inner'], cfg['dropout'], is_train,
         name + '_ffn'), name + '_ffn')
     return x
 
 
-def decoder_layer(x, enc, self_mask, cross_mask, cfg, is_train, name):
+def decoder_layer(x, enc, self_mask, cross_mask, cfg, is_train, name,
+                  src_lengths=None, trg_lengths=None):
     x = _prenorm(x, lambda h: multi_head_attention(
         h, h, self_mask, cfg['d_model'], cfg['n_head'], cfg['dropout'],
-        is_train, name + '_satt', cfg.get('use_flash', False), causal=True),
-        name + '_satt')
+        is_train, name + '_satt', cfg.get('use_flash', False), causal=True,
+        kv_lengths=trg_lengths), name + '_satt')
     x = _prenorm(x, lambda h: multi_head_attention(
         h, enc, cross_mask, cfg['d_model'], cfg['n_head'], cfg['dropout'],
-        is_train, name + '_xatt'), name + '_xatt')
+        is_train, name + '_xatt', cfg.get('use_flash', False),
+        kv_lengths=src_lengths), name + '_xatt')
     x = _prenorm(x, lambda h: ffn(
         h, cfg['d_model'], cfg['d_inner'], cfg['dropout'], is_train,
         name + '_ffn'), name + '_ffn')
@@ -134,13 +149,20 @@ def transformer(src_vocab, trg_vocab, max_len=64, n_layer=6, n_head=8,
 
     src_mask = _pad_mask(src_pad)                       # [B,1,1,Ts]
     cross_mask = src_mask
+    ones = layers.fill_constant_batch_size_like(src_pad, [-1, max_len],
+                                                'float32', 1.0)
+    src_len = layers.cast(layers.reduce_sum(
+        layers.elementwise_sub(ones, src_pad), dim=1), 'int32')
+    trg_len = layers.cast(layers.reduce_sum(
+        layers.elementwise_sub(ones, trg_pad), dim=1), 'int32')
     causal = layers.assign(_causal_mask_const(max_len))  # [1,1,Tt,Tt]
     trg_mask = layers.elementwise_add(_pad_mask(trg_pad), causal)
 
     enc = _embed(src, src_vocab, d_model, max_len, dropout, is_train,
                  'src')
     for i in range(n_layer):
-        enc = encoder_layer(enc, src_mask, cfg, is_train, 'enc_%d' % i)
+        enc = encoder_layer(enc, src_mask, cfg, is_train, 'enc_%d' % i,
+                            lengths=src_len)
     enc = layers.layer_norm(enc, begin_norm_axis=2,
                             param_attr=ParamAttr(name='enc_post_ln_w'),
                             bias_attr=ParamAttr(name='enc_post_ln_b'))
@@ -149,7 +171,8 @@ def transformer(src_vocab, trg_vocab, max_len=64, n_layer=6, n_head=8,
                  'trg')
     for i in range(n_layer):
         dec = decoder_layer(dec, enc, trg_mask, cross_mask, cfg, is_train,
-                            'dec_%d' % i)
+                            'dec_%d' % i, src_lengths=src_len,
+                            trg_lengths=trg_len)
     dec = layers.layer_norm(dec, begin_norm_axis=2,
                             param_attr=ParamAttr(name='dec_post_ln_w'),
                             bias_attr=ParamAttr(name='dec_post_ln_b'))

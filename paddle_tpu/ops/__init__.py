@@ -13,3 +13,4 @@ from . import optimizer_ops  # noqa
 from . import metric  # noqa
 from . import sequence  # noqa
 from . import detection  # noqa
+from . import attention  # noqa

@@ -1,0 +1,166 @@
+"""Model zoo tests: each model builds and trains, loss decreases
+(model: reference book/benchmark convergence tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train(out, feed_fn, steps=25, loss_key='loss'):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for i in range(steps):
+        l, = exe.run(feed=feed_fn(i), fetch_list=[out[loss_key]])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    return losses
+
+
+def test_fit_a_line_converges():
+    from paddle_tpu.models import simple
+    import paddle_tpu.dataset.uci_housing as uci
+    out = simple.fit_a_line(lr=0.05)
+    data = list(uci.train()())
+
+    def feed(i):
+        rows = data[(i * 32) % 300:(i * 32) % 300 + 32]
+        return {'x': np.stack([r[0] for r in rows]),
+                'y': np.stack([r[1] for r in rows])}
+    losses = _train(out, feed, steps=40)
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_mnist_cnn_converges():
+    from paddle_tpu.models import mnist as m
+    import paddle_tpu.dataset.mnist as md
+    out = m.build(lr=0.003)
+    data = list(md.train()())[:512]
+
+    def feed(i):
+        rows = data[(i * 32) % 480:(i * 32) % 480 + 32]
+        return {'pixel': np.stack([r[0].reshape(1, 28, 28) for r in rows]),
+                'label': np.array([[r[1]] for r in rows], 'int64')}
+    losses = _train(out, feed, steps=25)
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_word2vec_builds_and_steps():
+    from paddle_tpu.models import word2vec
+    out = word2vec.build(dict_size=100, embed_size=8, hidden_size=16)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        grams = rng.randint(0, 100, (16, 5))
+        d = {'word_%d' % j: grams[:, j:j + 1].astype('int64')
+             for j in range(4)}
+        d['next_word'] = grams[:, 4:5].astype('int64')
+        return d
+    losses = _train(out, feed, steps=10)
+    assert np.all(np.isfinite(losses))
+
+
+def test_ctr_deepfm_converges():
+    from paddle_tpu.models import ctr
+    out = ctr.deepfm(sparse_slots=8, dense_dim=4, vocab_size=100,
+                     embed_dim=4, fc_sizes=(16,))
+    data = list(ctr.synthetic_reader(
+        512, sparse_slots=8, dense_dim=4, vocab_size=100)())
+
+    def feed(i):
+        rows = data[(i * 64) % 448:(i * 64) % 448 + 64]
+        return {'dense_input': np.stack([r[0] for r in rows]),
+                'sparse_input': np.stack([r[1] for r in rows]),
+                'label': np.array([r[2] for r in rows], 'int64')}
+    losses = _train(out, feed, steps=30)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+
+def test_resnet_cifar_builds_and_steps():
+    from paddle_tpu.models import resnet
+    out = resnet.build(data_shape=(3, 32, 32), class_dim=10, depth=20,
+                       lr=0.05, data_set='cifar10')
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        return {'data': rng.rand(8, 3, 32, 32).astype('float32'),
+                'label': rng.randint(0, 10, (8, 1)).astype('int64')}
+    losses = _train(out, feed, steps=4)
+    assert np.all(np.isfinite(losses))
+
+
+def test_transformer_tiny_converges():
+    from paddle_tpu.models import transformer as tr
+    out = tr.transformer(64, 64, max_len=16, n_layer=1, n_head=2,
+                         d_model=32, d_inner=64, dropout=0.0,
+                         label_smooth_eps=0.0)
+    fluid.optimizer.Adam(3e-3).minimize(out['loss'])
+    rng = np.random.RandomState(0)
+    fixed_rows = []
+    for _ in range(8):
+        L = rng.randint(4, 14)
+        s = rng.randint(3, 64, (L,))
+        fixed_rows.append((s, np.concatenate([[0], s]),
+                           np.concatenate([s, [1]])))
+    feed_dict = tr.make_batch(fixed_rows, 16)
+    losses = _train(out, lambda i: feed_dict, steps=60)
+    assert losses[-1] < 0.3 * losses[0]
+
+
+def test_transformer_flash_matches_composed():
+    from paddle_tpu.models import transformer as tr
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(4):
+        L = rng.randint(4, 14)
+        s = rng.randint(3, 64, (L,))
+        rows.append((s, np.concatenate([[0], s]),
+                     np.concatenate([s, [1]])))
+    feed = tr.make_batch(rows, 16)
+
+    results = []
+    for use_flash in (False, True):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 7
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                out = tr.transformer(64, 64, max_len=16, n_layer=1,
+                                     n_head=2, d_model=32, d_inner=64,
+                                     dropout=0.0, use_flash=use_flash)
+                fluid.optimizer.SGD(0.1).minimize(out['loss'])
+        exe = fluid.Executor()
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            l0, = exe.run(main, feed=feed, fetch_list=[out['loss']])
+            l1, = exe.run(main, feed=feed, fetch_list=[out['loss']])
+            results.append((float(l0[0]), float(l1[0])))
+    # forward AND post-SGD-step losses agree -> gradients agree too
+    assert results[0][0] == pytest.approx(results[1][0], rel=2e-3)
+    assert results[0][1] == pytest.approx(results[1][1], rel=2e-3)
+
+
+def test_stacked_lstm_builds_and_steps():
+    from paddle_tpu.models import stacked_lstm
+    from paddle_tpu.core.lod import create_lod_tensor
+    out = stacked_lstm.build(dict_dim=50, emb_dim=8, hid_dim=8,
+                             stacked_num=2)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        rows = [rng.randint(0, 50, (rng.randint(3, 8), 1)).astype('int64')
+                for _ in range(4)]
+        return {'words': create_lod_tensor(rows),
+                'label': rng.randint(0, 2, (4, 1)).astype('int64')}
+    losses = _train(out, feed, steps=5)
+    assert np.all(np.isfinite(losses))
+
+
+def test_vgg_builds_and_steps():
+    from paddle_tpu.models import vgg
+    out = vgg.build(data_shape=(3, 32, 32), class_dim=10)
+    rng = np.random.RandomState(0)
+
+    def feed(i):
+        return {'data': rng.rand(4, 3, 32, 32).astype('float32'),
+                'label': rng.randint(0, 10, (4, 1)).astype('int64')}
+    losses = _train(out, feed, steps=3)
+    assert np.all(np.isfinite(losses))
