@@ -1,0 +1,65 @@
+"""Headline benchmark: Transformer-base training throughput on one TPU chip.
+
+Mirrors the reference's benchmark/fluid/fluid_benchmark.py harness
+(--model machine_translation reports words/sec); here the whole train step
+(fwd + vjp bwd + Adam) is ONE XLA executable.  Prints one JSON line.
+
+vs_baseline denominator: ~5100 tokens/s/GPU, the Fluid-era V100 fp32
+transformer-base figure recorded in SURVEY.md §5 (BASELINE.json has no
+published numbers).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 5100.0
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tr
+
+    B, T, vocab = 64, 64, 32000
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        with fluid.unique_name.guard():
+            out = tr.build(src_vocab=vocab, trg_vocab=vocab, max_len=T,
+                           n_layer=6, n_head=8, d_model=512, d_inner=2048,
+                           dropout=0.1, use_flash=False)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(B):
+        s = rng.randint(3, vocab, (T - 1,))
+        rows.append((np.concatenate([s, [1]]), np.concatenate([[0], s]),
+                     np.concatenate([s, [1]])))
+    feed = tr.make_batch(rows, T)
+    tokens_per_step = float(np.sum(1.0 - feed['trg_pad']))
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):  # compile + warmup
+            exe.run(main_prog, feed=feed, fetch_list=[out['loss']])
+        steps = 30
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, = exe.run(main_prog, feed=feed,
+                            fetch_list=[out['loss']])
+        np.asarray(loss)  # block
+        dt = time.perf_counter() - t0
+
+    tps = steps * tokens_per_step / dt
+    print(json.dumps({
+        'metric': 'transformer_base_tokens_per_sec_per_chip',
+        'value': round(tps, 1),
+        'unit': 'tokens/s',
+        'vs_baseline': round(tps / BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    sys.exit(main())

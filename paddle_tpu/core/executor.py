@@ -254,9 +254,11 @@ class Executor(object):
         self.mesh = mesh
         self._cache = {}
         self._run_counter = {}
+        self._shard_targets = {}
 
     def close(self):
         self._cache.clear()
+        self._shard_targets.clear()
 
     def _resolve_fetch(self, fetch_list):
         names = []
@@ -324,6 +326,22 @@ class Executor(object):
                     'persistable var "%s" not initialized in scope — run the '
                     'startup program first (exe.run(startup_program))' % n)
             params[n] = scope.vars[n]
+        if self.mesh is not None:
+            # arrays in scope may carry a different (e.g. replicated)
+            # committed sharding from the startup run; reshard to the
+            # program's annotated layout.  Target shardings are cached per
+            # lowering entry, and device_put is skipped once the written-
+            # back arrays already carry the right sharding (steady state).
+            targets = self._shard_targets.get(key)
+            if targets is None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                spec = program._sharding
+                targets = {n: NamedSharding(self.mesh, spec.get(n, P()))
+                           for n in params_in}
+                self._shard_targets[key] = targets
+            params = {n: (v if getattr(v, 'sharding', None) == targets[n]
+                          else jax.device_put(v, targets[n]))
+                      for n, v in params.items()}
 
         counter = self._run_counter.get(key, 0)
         self._run_counter[key] = counter + 1

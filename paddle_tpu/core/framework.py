@@ -553,6 +553,12 @@ class Program(object):
     def _bump(self):
         self._version += 1
 
+    def set_sharding(self, name, spec):
+        """Attach a PartitionSpec to var `name`; bumps the version so the
+        executor's lowering cache re-jits with the new in_shardings."""
+        self._sharding[name] = spec
+        self._bump()
+
     def global_block(self):
         return self.blocks[0]
 

@@ -6,5 +6,10 @@ modes): data/tensor/pipeline/sequence parallelism are expressed as sharding
 annotations over a `jax.sharding.Mesh`; XLA GSPMD inserts the collectives
 (all-reduce/all-gather/reduce-scatter) over ICI.
 """
-from .mesh import make_mesh, default_mesh, set_default_mesh  # noqa
+from .mesh import make_mesh, default_mesh, set_default_mesh, shard_map  # noqa
 from .parallel_executor import ParallelExecutor  # noqa
+from .tp import shard_program_tp, annotate  # noqa
+from .ring_attention import ring_attention, ring_attention_sharded  # noqa
+from .pipeline import pipeline_apply, stack_stage_params  # noqa
+from .sharded_embedding import shard_embedding, sharded_embedding  # noqa
+from . import moe  # noqa

@@ -1,0 +1,95 @@
+"""Mixture-of-Experts with expert parallelism (GShard/Switch-style).
+
+No reference equivalent (Fluid predates MoE); first-class here because
+expert parallelism is a core TPU scaling axis (SURVEY §2.4).  Design is
+the GSPMD dense-dispatch formulation: routing is expressed as dispatch /
+combine einsums over a capacity-bounded buffer, experts are stacked with a
+leading E dim sharded over the expert mesh axis, and XLA GSPMD turns the
+dispatch einsums into all-to-alls over ICI.  Static shapes throughout
+(capacity factor bounds the per-expert token count), so one compile.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['moe_ffn', 'top2_gating', 'init_moe_params']
+
+
+def top2_gating(logits, capacity):
+    """GShard top-2 gating.  logits: [G, S, E] (groups × tokens × experts).
+    Returns (dispatch [G,S,E,C] bool-ish float, combine [G,S,E,C] float,
+    aux_loss scalar)."""
+    G, S, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    g1_idx = jnp.argmax(probs, axis=-1)                      # [G,S]
+    mask1 = jax.nn.one_hot(g1_idx, E, dtype=probs.dtype)
+    probs2 = probs * (1.0 - mask1)
+    g2_idx = jnp.argmax(probs2, axis=-1)
+    mask2 = jax.nn.one_hot(g2_idx, E, dtype=probs.dtype)
+
+    # load-balancing auxiliary loss (Switch eq. 4): E * <fraction routed
+    # to e> . <mean gate prob of e>
+    density = mask1.mean(axis=1)                             # [G,E]
+    density_proxy = probs.mean(axis=1)
+    aux = (density * density_proxy).sum(axis=-1).mean() * (E * E)
+
+    # positions within each expert's capacity buffer (running count)
+    pos1 = (jnp.cumsum(mask1, axis=1) - mask1)               # [G,S,E]
+    mask1 = mask1 * (pos1 < capacity)
+    pos2 = (jnp.cumsum(mask2, axis=1) - mask2
+            + mask1.sum(axis=1, keepdims=True))
+    mask2 = mask2 * (pos2 < capacity)
+
+    g1 = (probs * mask1).sum(axis=-1)                        # [G,S]
+    g2 = (probs * mask2).sum(axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    loc1 = jax.nn.one_hot((pos1 * mask1).sum(-1).astype(jnp.int32),
+                          capacity, dtype=probs.dtype)       # [G,S,C]
+    loc2 = jax.nn.one_hot((pos2 * mask2).sum(-1).astype(jnp.int32),
+                          capacity, dtype=probs.dtype)
+    combine = (g1[..., None, None] * mask1[..., None] * loc1[:, :, None]
+               + g2[..., None, None] * mask2[..., None] * loc2[:, :, None])
+    dispatch = (combine > 0).astype(probs.dtype)             # [G,S,E,C]
+    return dispatch, combine, aux
+
+
+def init_moe_params(key, d_model, d_ff, n_expert, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = d_model ** -0.5
+    return {
+        'gate_w': jax.random.normal(k1, (d_model, n_expert), dtype) * s1,
+        'wi': jax.random.normal(k2, (n_expert, d_model, d_ff), dtype) * s1,
+        'wo': jax.random.normal(k3, (n_expert, d_ff, d_model),
+                                dtype) * (d_ff ** -0.5),
+    }
+
+
+def moe_ffn(params, x, capacity_factor=2.0):
+    """MoE feed-forward.  x: [G, S, D] (G groups = batch rows or shards).
+
+    Pure-JAX path: annotate `params['wi']/['wo']` with P(expert_axis, ..)
+    (see `shard_moe`) and GSPMD emits the all-to-alls.  Returns
+    (y [G,S,D], aux_loss)."""
+    G, S, D = x.shape
+    E = params['wi'].shape[0]
+    C = int(capacity_factor * S / E) or 1
+    logits = jnp.einsum('gsd,de->gse', x, params['gate_w'])
+    dispatch, combine, aux = top2_gating(logits, C)
+    # all-to-all happens here under GSPMD (tokens → their expert's shard)
+    xe = jnp.einsum('gsec,gsd->egcd', dispatch, x)           # [E,G,C,D]
+    h = jnp.einsum('egcd,edf->egcf', xe, params['wi'])
+    h = jax.nn.relu(h)
+    ye = jnp.einsum('egcf,efd->egcd', h, params['wo'])
+    y = jnp.einsum('gsec,egcd->gsd', combine, ye)
+    return y, aux
+
+
+def shard_moe(program, names=('wi', 'wo'), expert_axis='model'):
+    """Annotate stacked expert weights: leading E dim over the expert
+    axis."""
+    for n in names:
+        program.set_sharding(n, P(expert_axis, None, None))
+    return program

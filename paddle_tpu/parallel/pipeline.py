@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style microbatching over the 'pipe' mesh axis.
+
+TPU-native design (no reference equivalent — Fluid scaled only via data
+parallel + pservers): stage s holds its layer weights (leading stage dim
+sharded over 'pipe'); activations flow stage→stage via `lax.ppermute`
+neighbour hops on ICI inside a `lax.scan` over M + S - 1 ticks.  All
+stages run the SAME traced program SPMD-style — there is no per-stage
+Python code, so one compile serves every device.
+
+Constraint (standard for scan pipelines): every stage maps activations of
+one fixed shape/dtype to the same shape/dtype (transformer layer stacks).
+Embedding / head live outside the pipelined region.
+
+Differentiable end-to-end (scan + ppermute transpose exactly).
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import shard_map
+
+__all__ = ['pipeline_apply', 'stack_stage_params']
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree per stage] -> one pytree with leading stage dim (shard it
+    P('pipe', ...))."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_apply(mesh, stage_fn, stacked_params, x, n_micro,
+                   axis_name='pipe', data_axis=None):
+    """Run `stage_fn(stage_params, act) -> act` as an S-stage pipeline.
+
+    stacked_params: pytree, leaves [S, ...] (stage-major).
+    x: [B, ...] global batch; B divisible by n_micro (and by the 'data'
+    axis size if data_axis given).  Returns [B, ...] outputs.
+    """
+    S = mesh.shape[axis_name]
+    B = x.shape[0]
+    assert B % n_micro == 0, (B, n_micro)
+    n_stage = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    assert n_stage == S, (
+        'stacked_params has %d stages but mesh axis %r has %d devices '
+        '(one stage per pipeline device)' % (n_stage, axis_name, S))
+
+    def local(wstack, xm):
+        # wstack leaves: [1, ...] (this stage's slice); xm: [M, mb, ...]
+        w = jax.tree_util.tree_map(lambda a: a[0], wstack)
+        sid = lax.axis_index(axis_name)
+        M = xm.shape[0]
+        state = jnp.zeros_like(xm[0])
+        outputs = jnp.zeros_like(xm)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t; later stages use what arrived
+            inp = jnp.where(sid == 0, xm[jnp.clip(t, 0, M - 1)], state)
+            y = stage_fn(w, inp)
+            oidx = t - (S - 1)
+            keep = (sid == S - 1) & (oidx >= 0)
+            slot = jnp.clip(oidx, 0, M - 1)
+            outputs = outputs.at[slot].set(
+                jnp.where(keep, y, outputs[slot]))
+            nxt = lax.ppermute(y, axis_name,
+                               [(i, (i + 1) % S) for i in range(S)])
+            return (nxt, outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (state, outputs),
+                                   jnp.arange(M + S - 1))
+        # only the last stage holds real outputs; psum replicates them
+        # (every other stage contributes zeros)
+        return lax.psum(outputs, axis_name)
+
+    xm = x.reshape((n_micro, B // n_micro) + x.shape[1:])
+    w_specs = jax.tree_util.tree_map(
+        lambda a: P(axis_name, *([None] * (a.ndim - 1))), stacked_params)
+    act_spec = P(None, data_axis) if data_axis else P()
+    out = shard_map(local, mesh=mesh,
+                    in_specs=(w_specs, act_spec), out_specs=act_spec)(stacked_params, xm)
+    return out.reshape((B,) + out.shape[2:])

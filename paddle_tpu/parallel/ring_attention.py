@@ -1,0 +1,94 @@
+"""Ring attention: exact attention over sequence shards (context parallel).
+
+First-class long-context support (SURVEY §2.4).  The reference has no
+sequence parallelism; on TPU this is how attention scales past one chip's
+HBM: Q stays resident per device, K/V blocks rotate around the ring of
+devices on the `seq` mesh axis via `lax.ppermute` (ICI neighbour hops),
+and the softmax is accumulated online (flash-attention style running max /
+denominator), so the full [T, T] score matrix never materialises and each
+device only ever holds 1/n of K and V.
+
+Differentiable: the ring is a `lax.scan` of ppermutes + matmuls, and JAX
+transposes ppermute exactly, so jax.vjp gives the exact backward ring for
+free.  Wrap the caller in `jax.checkpoint` to avoid saving per-hop K/V.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from .mesh import shard_map
+
+__all__ = ['ring_attention', 'ring_attention_sharded']
+
+_NEG = -1e30
+
+
+def _local_ring_attention(q, k, v, axis_name, causal, scale):
+    """Per-shard body. q,k,v: [B, H, Tl, D] local blocks; Tl = T / n_dev."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Tl, D = q.shape
+    q = q * scale
+
+    # global positions of this device's query rows
+    q_pos = idx * Tl + jnp.arange(Tl)  # [Tl]
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # k_blk arrived from device (idx + i) mod n
+        src = (idx + i) % n
+        k_pos = src * Tl + jnp.arange(Tl)  # [Tl]
+        s = jnp.einsum('bhqd,bhkd->bhqk', q, k_blk,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tl, Tl]
+            s = jnp.where(mask[None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))          # [B,H,Tl]
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            'bhqk,bhkd->bhqd', p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        # rotate K/V to the next device (neighbour hop on ICI)
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
+    m0 = jnp.full((B, H, Tl), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name='seq', causal=False,
+                   scale=None):
+    """Exact attention with q/k/v sharded on the sequence dim.
+
+    q, k, v: [B, H, T, D] jax arrays (global view), T divisible by the size
+    of `axis_name` in `mesh`.  Batch stays on 'data' if that axis exists.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    data = 'data' if 'data' in mesh.axis_names else None
+    spec = P(data, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(_local_ring_attention, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ring_attention_sharded(axis_name='seq', causal=False, scale=None):
+    """Variant for use INSIDE an existing shard_map region: takes local
+    [B, H, Tl, D] blocks directly."""
+    def fn(q, k, v):
+        s = scale if scale is not None else q.shape[-1] ** -0.5
+        return _local_ring_attention(q, k, v, axis_name, causal, s)
+    return fn

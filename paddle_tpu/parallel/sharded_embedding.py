@@ -1,0 +1,41 @@
+"""Sharded embedding — TPU replacement for the distributed lookup table.
+
+Parity: reference transpiler/distribute_lookup_table.py +
+operators/lookup_table_op (the sparse pserver path, where huge embeddings
+are split by row across parameter servers and trainers send prefetch
+RPCs).  On TPU the table lives sharded over the mesh: annotate the
+parameter P(axis, None) (vocab-sharded) and GSPMD turns the gather into an
+all-gather-free one-hot matmul / collective lookup over ICI.  The API
+below attaches the annotation to an existing `layers.embedding` parameter.
+"""
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['shard_embedding', 'sharded_embedding']
+
+
+def shard_embedding(program, param_name, axis='model'):
+    """Mark embedding `param_name` ([V, D]) as row(vocab)-sharded."""
+    program.set_sharding(param_name, P(axis, None))
+    return program
+
+
+def sharded_embedding(input, size, param_attr=None, dtype='float32',
+                      is_sparse=False, is_distributed=True, axis='model',
+                      padding_idx=None):
+    """Drop-in for fluid.layers.embedding(is_distributed=True): build the
+    embedding and annotate its weight over the model axis of the default
+    program."""
+    from .. import layers
+    from ..core.framework import default_main_program
+    from ..param_attr import ParamAttr
+    param_attr = ParamAttr._to_attr(param_attr)
+    out = layers.embedding(input, size, is_sparse=is_sparse,
+                           is_distributed=is_distributed,
+                           padding_idx=padding_idx,
+                           param_attr=param_attr, dtype=dtype)
+    prog = default_main_program()
+    # the embedding layer registered exactly one new parameter; find it
+    # via the op that produced `out`
+    w_name = out.op.inputs['W'][0]
+    shard_embedding(prog, w_name, axis=axis)
+    return out

@@ -60,8 +60,8 @@ class DistributeTranspiler(object):
         # mark every data var as batch-sharded over the 'data' mesh axis
         from jax.sharding import PartitionSpec as P
         for v in program.global_block().vars.values():
-            if v.is_data:
-                program._sharding.setdefault(v.name, P('data'))
+            if v.is_data and v.name not in program._sharding:
+                program.set_sharding(v.name, P('data'))
         program._dist_info = {'trainer_id': trainer_id,
                               'num_trainers': trainers,
                               'mode': self.config.mode}

@@ -1,0 +1,184 @@
+"""Parallel stack tests on the 8-virtual-device CPU mesh (conftest)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.ring_attention import ring_attention
+
+
+def _full_attention(q, k, v, causal=False):
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * (q.shape[-1] ** -0.5)
+    if causal:
+        T = q.shape[2]
+        mask = np.tril(np.ones((T, T), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    return jnp.einsum('bhqk,bhkd->bhqd', jax.nn.softmax(s, axis=-1), v)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = make_mesh(data=2, seq=4, model=1, pipe=1)
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 32, 8
+    q = rng.randn(B, H, T, D).astype('float32')
+    k = rng.randn(B, H, T, D).astype('float32')
+    v = rng.randn(B, H, T, D).astype('float32')
+    with mesh:
+        out = ring_attention(q, k, v, mesh, causal=causal)
+    ref = _full_attention(jnp.array(q), jnp.array(k), jnp.array(v), causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grads_match_full():
+    mesh = make_mesh(data=1, seq=4, model=1, pipe=1,
+                     devices=jax.devices()[:4])
+    rng = np.random.RandomState(1)
+    B, H, T, D = 1, 2, 16, 4
+    q = rng.randn(B, H, T, D).astype('float32')
+    k = rng.randn(B, H, T, D).astype('float32')
+    v = rng.randn(B, H, T, D).astype('float32')
+
+    def loss_ring(q, k, v):
+        with mesh:
+            return ring_attention(q, k, v, mesh, causal=True).sum()
+
+    def loss_full(q, k, v):
+        return _full_attention(q, k, v, causal=True).sum()
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(
+        jnp.array(q), jnp.array(k), jnp.array(v))
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
+
+
+def _mnist_like_program(seed):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            img = fluid.layers.data('img', shape=[32], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(img, 64, act='relu')
+            logits = fluid.layers.fc(h, 10)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(
+                    fluid.layers.softmax(logits), lbl))
+            fluid.optimizer.SGD(0.5).minimize(loss)
+    return main, startup, loss
+
+
+def test_data_parallel_matches_single_device():
+    rng = np.random.RandomState(0)
+    feed = {'img': rng.randn(16, 32).astype('float32'),
+            'lbl': rng.randint(0, 10, (16, 1)).astype('int64')}
+
+    losses = {}
+    for tag, mesh in [('single', None),
+                      ('dp8', make_mesh(data=8, model=1, pipe=1, seq=1))]:
+        main, startup, loss = _mnist_like_program(seed=3)
+        if mesh is not None:
+            t = fluid.DistributeTranspiler()
+            t.transpile(0, program=main, trainers=8)
+        exe = fluid.Executor(mesh=mesh)
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            vals = []
+            for _ in range(4):
+                l, = exe.run(main, feed=feed, fetch_list=[loss])
+                vals.append(float(np.asarray(l).ravel()[0]))
+        losses[tag] = vals
+    np.testing.assert_allclose(losses['single'], losses['dp8'],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tp_annotation_and_run():
+    from paddle_tpu.models import transformer as tr
+    from paddle_tpu.parallel.tp import shard_program_tp
+    mesh = make_mesh(data=2, model=4, pipe=1, seq=1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            out = tr.transformer(64, 64, max_len=16, n_layer=1, n_head=4,
+                                 d_model=32, d_inner=64, dropout=0.0,
+                                 label_smooth_eps=0.0)
+            fluid.optimizer.Adam(1e-3).minimize(out['loss'])
+    applied = shard_program_tp(main)
+    assert len(applied) >= 8  # q/k/v/o + fc1/fc2 (+ proj, emb) weights
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(8):
+        s = rng.randint(3, 64, (10,))
+        rows.append((s, np.concatenate([[0], s]), np.concatenate([s, [1]])))
+    feed = tr.make_batch(rows, 16)
+    exe = fluid.Executor(mesh=mesh)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        with mesh:
+            l0, = exe.run(main, feed=feed, fetch_list=[out['loss']])
+            l1, = exe.run(main, feed=feed, fetch_list=[out['loss']])
+    assert np.isfinite(l0).all() and float(l1[0]) < float(l0[0])
+
+
+def test_pipeline_matches_sequential():
+    from paddle_tpu.parallel.pipeline import (pipeline_apply,
+                                              stack_stage_params)
+    mesh = make_mesh(data=2, pipe=4, model=1, seq=1)
+    rng = np.random.RandomState(0)
+    S, B, D = 4, 8, 16
+    params = [{'w': rng.randn(D, D).astype('float32') * 0.3,
+               'b': rng.randn(D).astype('float32') * 0.1}
+              for _ in range(S)]
+    x = rng.randn(B, D).astype('float32')
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p['w'] + p['b'])
+
+    stacked = stack_stage_params(params)
+    with mesh:
+        out = pipeline_apply(mesh, stage_fn, stacked, jnp.array(x),
+                             n_micro=4, data_axis='data')
+    ref = jnp.array(x)
+    for p in params:
+        ref = stage_fn({'w': jnp.array(p['w']), 'b': jnp.array(p['b'])},
+                       ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_differentiable():
+    from paddle_tpu.parallel.pipeline import (pipeline_apply,
+                                              stack_stage_params)
+    mesh = make_mesh(data=1, pipe=4, model=1, seq=1,
+                     devices=jax.devices()[:4])
+    rng = np.random.RandomState(1)
+    S, B, D = 4, 4, 8
+    params = [{'w': rng.randn(D, D).astype('float32') * 0.3} for _ in
+              range(S)]
+    x = jnp.array(rng.randn(B, D).astype('float32'))
+    stacked = stack_stage_params(params)
+
+    def stage_fn(p, h):
+        return jnp.tanh(h @ p['w'])
+
+    def loss_pipe(w):
+        with mesh:
+            return pipeline_apply(mesh, stage_fn, w, x, n_micro=2).sum()
+
+    def loss_seq(params):
+        h = x
+        for p in params:
+            h = stage_fn(p, h)
+        return h.sum()
+
+    gp = jax.grad(loss_pipe)(stacked)
+    gs = stack_stage_params(jax.grad(loss_seq)(
+        [{'w': jnp.array(p['w'])} for p in params]))
+    np.testing.assert_allclose(np.asarray(gp['w']), np.asarray(gs['w']),
+                               atol=2e-5, rtol=2e-5)
