@@ -271,5 +271,19 @@ def generate_mask_labels(*args, **kwargs):
 
 
 def roi_perspective_transform(input, rois, transformed_height,
-                              transformed_width, spatial_scale=1.0):
-    raise NotImplementedError('use roi_align for TPU deployments')
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch=None):
+    """Perspective-warp quad ROIs (R, 8) to fixed (th, tw) output.
+    Ref: layers/detection.py roi_perspective_transform /
+    operators/detection/roi_perspective_transform_op.cc."""
+    helper = LayerHelper('roi_perspective_transform')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {'X': input, 'ROIs': rois}
+    if rois_batch is not None:
+        ins['RoisBatch'] = rois_batch
+    helper.append_op(type='roi_perspective_transform', inputs=ins,
+                     outputs={'Out': out},
+                     attrs={'transformed_height': transformed_height,
+                            'transformed_width': transformed_width,
+                            'spatial_scale': spatial_scale})
+    return out

@@ -117,9 +117,34 @@ def double_buffer(reader, place=None, name=None):
 
 
 def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
-               buffer_size=None, pass_num=1, is_test=None):
-    raise NotImplementedError(
-        'open_files: use paddle_tpu.native datafeed readers + py_reader')
+               buffer_size=None, pass_num=1, is_test=None, batch_size=1,
+               shuffle_capacity=0, seed=0):
+    """File reader over ptrec files via the native C++ pipeline.
+
+    Parity: reference layers/io.py open_files (recordio multi-file reader
+    with background threads).  Returns a _PyReader whose batches come from
+    paddle_tpu.native.BatchReader — parsing/shuffle/batch assembly run in
+    C++ threads off the GIL, prefetch depth = buffer_size.
+    """
+    from ..native import BatchReader
+    from ..core import unique_name
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    r = py_reader(capacity=buffer_size or 4, shapes=shapes, dtypes=dtypes,
+                  lod_levels=lod_levels,
+                  name=unique_name.generate('open_files'))
+    loop = pass_num <= 0
+
+    def gen():
+        for _ in range(max(pass_num, 1) if not loop else 1):
+            for batch_ in BatchReader(
+                    filenames, batch_size=batch_size,
+                    shuffle_capacity=shuffle_capacity, seed=seed,
+                    loop_forever=loop, prefetch=buffer_size or 4):
+                yield batch_
+
+    r.decorate_paddle_reader(gen)
+    return r
 
 
 def random_data_generator(low, high, shapes, lod_levels, for_parallel=True):

@@ -49,7 +49,8 @@ __all__ = [
     'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'chunk_eval',
     'flash_attention',
     'linear_chain_crf', 'crf_decoding', 'one_hot', 'group_norm',
-    'teacher_student_sigmoid_loss',
+    'teacher_student_sigmoid_loss', 'roi_pool', 'roi_align', 'psroi_pool',
+    'conv_shift', 'tree_conv', 'beam_search', 'beam_search_decode',
 ]
 
 
@@ -1595,3 +1596,158 @@ def flash_attention(q, k, v, causal=False, k_lengths=None, name=None):
     helper.append_op(type='flash_attention', inputs=ins,
                      outputs={'Out': out}, attrs={'causal': causal})
     return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_batch=None):
+    """Max ROI pooling.  Ref: layers/nn.py:6453 (roi_pool).
+
+    `rois` is (R, 4); the reference carries the per-ROI batch image index in
+    the ROIs' LoD — here it is the optional dense `rois_batch` (R,) int input
+    (defaults to image 0, the single-image case).
+    """
+    helper = LayerHelper('roi_pool')
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {'X': input, 'ROIs': rois}
+    if rois_batch is not None:
+        ins['RoisBatch'] = rois_batch
+    helper.append_op(type='roi_pool', inputs=ins, outputs={'Out': out},
+                     attrs={'pooled_height': pooled_height,
+                            'pooled_width': pooled_width,
+                            'spatial_scale': spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_batch=None):
+    """Bilinear ROI align.  Ref: layers/nn.py:6491 (roi_align)."""
+    helper = LayerHelper('roi_align', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {'X': input, 'ROIs': rois}
+    if rois_batch is not None:
+        ins['RoisBatch'] = rois_batch
+    helper.append_op(type='roi_align', inputs=ins, outputs={'Out': out},
+                     attrs={'pooled_height': pooled_height,
+                            'pooled_width': pooled_width,
+                            'spatial_scale': spatial_scale,
+                            'sampling_ratio': sampling_ratio})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None, rois_batch=None):
+    """Position-sensitive ROI pooling (R-FCN).  Ref: layers/nn.py:9942."""
+    if not isinstance(output_channels, int):
+        raise TypeError("output_channels must be int type")
+    if not isinstance(spatial_scale, float):
+        raise TypeError("spatial_scale must be float type")
+    if not isinstance(pooled_height, int):
+        raise TypeError("pooled_height must be int type")
+    if not isinstance(pooled_width, int):
+        raise TypeError("pooled_width must be int type")
+    helper = LayerHelper('psroi_pool', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {'X': input, 'ROIs': rois}
+    if rois_batch is not None:
+        ins['RoisBatch'] = rois_batch
+    helper.append_op(type='psroi_pool', inputs=ins, outputs={'Out': out},
+                     attrs={'output_channels': output_channels,
+                            'spatial_scale': spatial_scale,
+                            'pooled_height': pooled_height,
+                            'pooled_width': pooled_width})
+    return out
+
+
+def conv_shift(x, y, name=None):
+    """Circular convolution of x (B, M) by kernel y (B, N), N odd.
+    Ref: layers/nn.py conv_shift / operators/conv_shift_op.cc."""
+    helper = LayerHelper('conv_shift', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='conv_shift', inputs={'X': x, 'Y': y},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act='tanh', param_attr=None, bias_attr=None, name=None):
+    """Tree-based convolution (TBCNN).  Ref: layers/nn.py:10044."""
+    helper = LayerHelper('tree_conv', name=name, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    dtype = nodes_vector.dtype
+    feature_size = nodes_vector.shape[2]
+    W = helper.create_parameter(attr=helper.param_attr,
+                                shape=[feature_size, 3, output_size,
+                                       num_filters],
+                                dtype=dtype, is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='tree_conv',
+                     inputs={'NodesVector': nodes_vector,
+                             'EdgeSet': edge_set, 'Filter': W},
+                     outputs={'Out': out},
+                     attrs={'max_depth': max_depth})
+    if helper.bias_attr:
+        out = helper.append_bias_op(out, dim_start=3)
+    return helper.append_activation(out)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id, level=0,
+                is_accumulated=True, name=None, return_parent_idx=False):
+    """One beam-search step.  Ref: layers/nn.py:3872.
+
+    Dense formulation (static beam width — see ops/sequence.py beam_search).
+    At step 0 feed pre_scores = [0, -inf, ...] per source so only beam 0 is
+    live.  Set `return_parent_idx=True` to also get the (R,) int32 gather
+    indices for reordering decoder state / writing the backtrace array.
+    """
+    helper = LayerHelper('beam_search', name=name)
+    selected_ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    selected_scores = helper.create_variable_for_type_inference(
+        pre_scores.dtype)
+    parent_idx = helper.create_variable_for_type_inference('int32')
+    inputs = {'pre_ids': pre_ids, 'pre_scores': pre_scores, 'scores': scores}
+    if ids is not None:
+        inputs['ids'] = ids
+    helper.append_op(type='beam_search', inputs=inputs,
+                     outputs={'selected_ids': selected_ids,
+                              'selected_scores': selected_scores,
+                              'parent_idx': parent_idx},
+                     attrs={'level': level, 'beam_size': beam_size,
+                            'end_id': end_id,
+                            'is_accumulated': is_accumulated})
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_idx
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None):
+    """Construct full hypotheses from per-step beam results.
+    Ref: layers/nn.py:3991.
+
+    `ids`/`scores` are TensorArrays written once per step; `parents` is the
+    TensorArray of parent_idx outputs from beam_search (the reference encodes
+    these back-pointers in each step's LoD; the dense formulation passes them
+    explicitly — identity if omitted, i.e. the caller already reordered rows
+    every step).  Returns (R, T) sentence ids and scores.
+    """
+    from . import control_flow as cf
+    helper = LayerHelper('beam_search_decode', name=name)
+    ids_vars = ids.vars if isinstance(ids, cf._TensorArray) else [ids]
+    sc_vars = scores.vars if isinstance(scores, cf._TensorArray) else [scores]
+    ids_stk = stack(ids_vars, axis=0)
+    sc_stk = stack(sc_vars, axis=0)
+    inputs = {'Ids': ids_stk, 'Scores': sc_stk}
+    if parents is not None:
+        p_vars = (parents.vars if isinstance(parents, cf._TensorArray)
+                  else [parents])
+        inputs['Parents'] = stack(p_vars, axis=0)
+    sentence_ids = helper.create_variable_for_type_inference(
+        ids_vars[0].dtype)
+    sentence_scores = helper.create_variable_for_type_inference(
+        sc_vars[0].dtype)
+    helper.append_op(type='beam_search_decode', inputs=inputs,
+                     outputs={'SentenceIds': sentence_ids,
+                              'SentenceScores': sentence_scores},
+                     attrs={'beam_size': beam_size, 'end_id': end_id})
+    return sentence_ids, sentence_scores

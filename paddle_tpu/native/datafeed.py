@@ -1,0 +1,190 @@
+"""Python face of the native data pipeline (ctypes bindings + fallback).
+
+Parity: reference python/paddle/fluid/data_feed_desc.py (DataFeedDesc),
+recordio python API, and the batch/shuffle/double_buffer reader decorators —
+backed by the C++ pipeline in src/datafeed.cc when a toolchain is present,
+else by `fallback.py` (same on-disk format, same semantics).
+"""
+import ctypes
+import os
+
+import numpy as np
+
+from . import fallback
+
+_DTYPE_CODES = {
+    np.dtype('float32'): 0, np.dtype('float64'): 1, np.dtype('int32'): 2,
+    np.dtype('int64'): 3, np.dtype('uint8'): 4, np.dtype('int16'): 5,
+    np.dtype('bool'): 6,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+_CODE_DTYPES[7] = np.dtype('uint16')  # bf16 carried as raw u16
+
+
+def _lib():
+    from . import get_lib
+    return get_lib()
+
+
+class RecordWriter(object):
+    """Writes samples (tuples of ndarrays) to a ptrec file."""
+
+    def __init__(self, path):
+        self.path = path
+        lib = _lib()
+        if lib is None:
+            self._impl = fallback.FallbackWriter(path)
+            self._h = None
+        else:
+            self._impl = None
+            self._h = lib.ptrec_writer_open(path.encode())
+            if not self._h:
+                raise IOError('cannot open %s for writing' % path)
+
+    def write(self, sample):
+        arrs = [np.ascontiguousarray(a) for a in sample]
+        if self._impl is not None:
+            return self._impl.write(arrs)
+        lib = _lib()
+        n = len(arrs)
+        dtypes = (ctypes.c_uint8 * n)(
+            *[_DTYPE_CODES[a.dtype] for a in arrs])
+        ndims = (ctypes.c_int32 * n)(*[a.ndim for a in arrs])
+        dims_flat = []
+        for a in arrs:
+            dims_flat.extend(a.shape)
+        dims = (ctypes.c_int64 * len(dims_flat))(*dims_flat)
+        ptrs = (ctypes.POINTER(ctypes.c_uint8) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+              for a in arrs])
+        nbytes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrs])
+        rc = lib.ptrec_writer_write(self._h, n, dtypes, ndims, dims,
+                                    ptrs, nbytes)
+        if rc != 0:
+            raise IOError('write failed on %s' % self.path)
+
+    def close(self):
+        if self._impl is not None:
+            self._impl.close()
+        elif self._h:
+            _lib().ptrec_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def write_records(path, samples):
+    with RecordWriter(path) as w:
+        for s in samples:
+            w.write(s)
+
+
+class BatchReader(object):
+    """Iterates batches (tuples of stacked ndarrays) from ptrec files.
+
+    shuffle_capacity > 0 enables the C++ reservoir shuffle buffer;
+    prefetch sets the depth of the ready-batch queue (double_buffer).
+    """
+
+    def __init__(self, paths, batch_size, shuffle_capacity=0, seed=0,
+                 drop_last=False, loop_forever=False, prefetch=4):
+        if isinstance(paths, str):
+            paths = [paths]
+        for p in paths:
+            if not os.path.exists(p):
+                raise IOError('no such file: %s' % p)
+        self._args = (paths, batch_size, shuffle_capacity, seed,
+                      drop_last, loop_forever, prefetch)
+        self._h = None
+        self._fallback = _lib() is None
+
+    def __iter__(self):
+        paths, bs, cap, seed, drop, loop, pf = self._args
+        if self._fallback:
+            for batch in fallback.iter_batches(paths, bs, cap, seed, drop,
+                                               loop):
+                yield batch
+            return
+        lib = _lib()
+        cpaths = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        h = lib.ptrec_reader_open(cpaths, len(paths), bs, cap, seed,
+                                  int(drop), int(loop), pf)
+        try:
+            while True:
+                nf = lib.ptrec_reader_next(h)
+                if nf < 0:
+                    raise IOError(lib.ptrec_reader_error(h).decode())
+                if nf == 0:
+                    return
+                fields = []
+                for i in range(nf):
+                    ndim = lib.ptrec_reader_field_ndim(h, i)
+                    dims = (ctypes.c_int64 * ndim)()
+                    lib.ptrec_reader_field_dims(h, i, dims)
+                    shape = tuple(dims)
+                    dt = _CODE_DTYPES[lib.ptrec_reader_field_dtype(h, i)]
+                    nbytes = int(np.prod(shape)) * dt.itemsize
+                    ptr = lib.ptrec_reader_field_data(h, i)
+                    buf = ctypes.cast(
+                        ptr, ctypes.POINTER(ctypes.c_uint8 * nbytes))
+                    # copy out: the C buffer is recycled on the next call
+                    fields.append(np.frombuffer(
+                        bytearray(buf.contents), dtype=dt).reshape(shape))
+                yield tuple(fields)
+        finally:
+            lib.ptrec_reader_close(h)
+
+
+class RecordReader(object):
+    """Sample-at-a-time reader (batch_size=1, squeezed): recordio parity."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __iter__(self):
+        for batch in BatchReader(self.path, batch_size=1):
+            yield tuple(f[0] for f in batch)
+
+
+class DataFeedDesc(object):
+    """Feed pipeline description (parity: fluid.DataFeedDesc /
+    data_feed.proto).  Declares slot names/types/shapes plus pipeline
+    parameters; `reader()` materializes the native BatchReader."""
+
+    def __init__(self, paths=None, batch_size=1, shuffle_capacity=0,
+                 seed=0, drop_last=False):
+        self.paths = paths or []
+        self.batch_size = batch_size
+        self.shuffle_capacity = shuffle_capacity
+        self.seed = seed
+        self.drop_last = drop_last
+        self.slots = []  # (name, dtype, shape)
+
+    def add_slot(self, name, dtype, shape):
+        self.slots.append((name, np.dtype(dtype), tuple(shape)))
+        return self
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_use_slots(self, names):
+        self.use_slots = list(names)
+
+    def reader(self, **overrides):
+        kw = dict(batch_size=self.batch_size,
+                  shuffle_capacity=self.shuffle_capacity, seed=self.seed,
+                  drop_last=self.drop_last)
+        kw.update(overrides)
+        return BatchReader(self.paths, **kw)
+
+    def desc(self):
+        lines = ['batch_size: %d' % self.batch_size]
+        for (name, dtype, shape) in self.slots:
+            lines.append('slot { name: "%s" type: "%s" shape: %s }'
+                         % (name, dtype.name, list(shape)))
+        return '\n'.join(lines)

@@ -1,0 +1,110 @@
+"""Pure-NumPy fallback for the native pipeline (same ptrec format).
+
+Used when no C++ toolchain is available at runtime.  Format doc in
+src/datafeed.cc.
+"""
+import random
+import struct
+import zlib
+
+import numpy as np
+
+_MAGIC = 0x50545231
+
+_DTYPE_CODES = {
+    np.dtype('float32'): 0, np.dtype('float64'): 1, np.dtype('int32'): 2,
+    np.dtype('int64'): 3, np.dtype('uint8'): 4, np.dtype('int16'): 5,
+    np.dtype('bool'): 6,
+}
+_CODE_DTYPES = {v: k for k, v in _DTYPE_CODES.items()}
+_CODE_DTYPES[7] = np.dtype('uint16')
+
+
+class FallbackWriter(object):
+    def __init__(self, path):
+        self.f = open(path, 'wb')
+
+    def write(self, arrs):
+        payload = bytearray(struct.pack('<H', len(arrs)))
+        for a in arrs:
+            payload += struct.pack('<BB', _DTYPE_CODES[a.dtype], a.ndim)
+            payload += struct.pack('<%dq' % a.ndim, *a.shape)
+            payload += a.tobytes()
+        self.f.write(struct.pack('<III', _MAGIC, len(payload),
+                                 zlib.crc32(bytes(payload)) & 0xFFFFFFFF))
+        self.f.write(payload)
+
+    def close(self):
+        self.f.close()
+
+
+def read_samples(path):
+    with open(path, 'rb') as f:
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            magic, ln, crc = struct.unpack('<III', hdr)
+            if magic != _MAGIC:
+                raise IOError('bad record frame in %s' % path)
+            payload = f.read(ln)
+            if len(payload) != ln or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise IOError('corrupt record in %s' % path)
+            off = 0
+            (nf,) = struct.unpack_from('<H', payload, off)
+            off += 2
+            fields = []
+            for _ in range(nf):
+                code, ndim = struct.unpack_from('<BB', payload, off)
+                off += 2
+                dims = struct.unpack_from('<%dq' % ndim, payload, off)
+                off += 8 * ndim
+                dt = _CODE_DTYPES[code]
+                nbytes = int(np.prod(dims)) * dt.itemsize if ndim else \
+                    dt.itemsize
+                arr = np.frombuffer(payload, dtype=dt, count=max(
+                    nbytes // dt.itemsize, 0), offset=off).reshape(dims)
+                off += nbytes
+                fields.append(arr.copy())
+            yield tuple(fields)
+
+
+def iter_batches(paths, batch_size, shuffle_capacity, seed, drop_last,
+                 loop_forever):
+    rng = random.Random(seed)
+
+    def samples():
+        while True:
+            for p in paths:
+                for s in read_samples(p):
+                    yield s
+            if not loop_forever:
+                return
+
+    def shuffled(it):
+        if shuffle_capacity <= 0:
+            for s in it:
+                yield s
+            return
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) >= shuffle_capacity:
+                i = rng.randrange(len(buf))
+                buf[i], buf[-1] = buf[-1], buf[i]
+                yield buf.pop()
+        while buf:
+            i = rng.randrange(len(buf))
+            buf[i], buf[-1] = buf[-1], buf[i]
+            yield buf.pop()
+
+    pending = []
+    for s in shuffled(samples()):
+        pending.append(s)
+        if len(pending) == batch_size:
+            yield tuple(np.stack([p[i] for p in pending])
+                        for i in range(len(pending[0])))
+            pending = []
+    if pending and not drop_last:
+        yield tuple(np.stack([p[i] for p in pending])
+                    for i in range(len(pending[0])))

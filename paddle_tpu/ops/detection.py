@@ -421,3 +421,128 @@ def roi_pool(ctx, ins, attrs):
 
     out = jax.vmap(one_roi)(rois, batch_idx)
     return {'Out': jnp.where(jnp.isfinite(out), out, 0.0), 'Argmax': None}
+
+
+@register('psroi_pool')
+def psroi_pool(ctx, ins, attrs):
+    """Position-sensitive ROI average pooling (R-FCN).
+
+    Ref: paddle/fluid/operators/psroi_pool_op.h.  Input channels are laid out
+    as (output_channels, pooled_h, pooled_w); bin (i, j) of output channel c
+    average-pools input channel (c*ph + i)*pw + j over the bin region.
+    """
+    x, rois = ins['X'], ins['ROIs']
+    oc = attrs['output_channels']
+    scale = attrs.get('spatial_scale', 1.0)
+    ph = attrs.get('pooled_height', 1)
+    pw = attrs.get('pooled_width', 1)
+    batch_idx = ins.get('RoisBatch')
+    if batch_idx is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    n, c, h, w = x.shape
+
+    def one_roi(roi, bi):
+        # std::round semantics (half away from zero), not jnp.round's
+        # half-to-even; end coords are round(v)+1 per the reference kernel
+        rnd = lambda v: jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+        x1 = rnd(roi[0]) * scale
+        y1 = rnd(roi[1]) * scale
+        x2 = (rnd(roi[2]) + 1.0) * scale
+        y2 = (rnd(roi[3]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = x[bi]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        out = jnp.zeros((oc, ph, pw), x.dtype)
+        for i in range(ph):
+            for j in range(pw):
+                hs = jnp.clip(jnp.floor(y1 + i * bin_h), 0, h).astype(jnp.int32)
+                he = jnp.clip(jnp.ceil(y1 + (i + 1) * bin_h), 0, h).astype(jnp.int32)
+                ws = jnp.clip(jnp.floor(x1 + j * bin_w), 0, w).astype(jnp.int32)
+                we = jnp.clip(jnp.ceil(x1 + (j + 1) * bin_w), 0, w).astype(jnp.int32)
+                m = (((ys >= hs) & (ys < he))[:, None] &
+                     ((xs >= ws) & (xs < we))[None, :]).astype(x.dtype)
+                area = jnp.maximum(m.sum(), 1.0)
+                ch = (jnp.arange(oc) * ph + i) * pw + j
+                out = out.at[:, i, j].set(
+                    (img[ch] * m[None]).sum(axis=(1, 2)) / area)
+        return out
+
+    return {'Out': jax.vmap(one_roi)(rois, batch_idx)}
+
+
+@register('roi_perspective_transform')
+def roi_perspective_transform(ctx, ins, attrs):
+    """Perspective-warp quadrilateral ROIs to a fixed rectangle.
+
+    Ref: paddle/fluid/operators/detection/roi_perspective_transform_op.cc.
+    ROIs are (R, 8) corner quads (x1 y1 ... x4 y4, clockwise from top-left).
+    The 3x3 homography rect->quad is solved per ROI as an 8x8 linear system
+    (batched jnp.linalg.solve lowers to XLA LU, fine on TPU), then the output
+    grid is bilinearly sampled from the input.
+    """
+    x, rois = ins['X'], ins['ROIs']
+    th = attrs['transformed_height']
+    tw = attrs['transformed_width']
+    scale = attrs.get('spatial_scale', 1.0)
+    batch_idx = ins.get('RoisBatch')
+    if batch_idx is None:
+        batch_idx = jnp.zeros((rois.shape[0],), jnp.int32)
+    n, c, h, w = x.shape
+
+    def one_roi(quad, bi):
+        pts = quad.reshape(4, 2) * scale  # (x, y) corners
+        # aspect-preserving normalized width (ref op .cc:121-134): the quad
+        # is mapped onto the first nw columns, the rest stay zero
+        side = jnp.sqrt(jnp.sum(
+            (pts - jnp.roll(pts, -1, axis=0)) ** 2, axis=1))
+        est_w = (side[0] + side[2]) / 2.0
+        est_h = (side[1] + side[3]) / 2.0
+        nw = jnp.minimum(
+            jnp.round(est_w * (th - 1) / jnp.maximum(est_h, 1e-6)) + 1.0,
+            float(tw))
+        # destination rect corners in output coords
+        dst = jnp.stack([
+            jnp.array([0., 0.], x.dtype),
+            jnp.stack([nw - 1.0, jnp.asarray(0.0, x.dtype)]),
+            jnp.stack([nw - 1.0, jnp.asarray(th - 1.0, x.dtype)]),
+            jnp.array([0., th - 1.], x.dtype)]).astype(x.dtype)
+        # solve a*8 homography coeffs mapping dst -> src
+        def row_pair(d, s):
+            dx, dy = d[0], d[1]
+            sx, sy = s[0], s[1]
+            r1 = jnp.array([dx, dy, 1., 0., 0., 0., -dx * sx, -dy * sx], x.dtype)
+            r2 = jnp.array([0., 0., 0., dx, dy, 1., -dx * sy, -dy * sy], x.dtype)
+            return jnp.stack([r1, r2]), jnp.array([sx, sy], x.dtype)
+        rows, rhs = jax.vmap(row_pair)(dst, pts)
+        A = rows.reshape(8, 8)
+        b = rhs.reshape(8)
+        coef = jnp.linalg.solve(A + 1e-8 * jnp.eye(8, dtype=x.dtype), b)
+        Hm = jnp.append(coef, 1.0).reshape(3, 3)
+        gy, gx = jnp.meshgrid(jnp.arange(th, dtype=x.dtype),
+                              jnp.arange(tw, dtype=x.dtype), indexing='ij')
+        ones = jnp.ones_like(gx)
+        src = jnp.einsum('ij,jhw->ihw', Hm, jnp.stack([gx, gy, ones]))
+        sx = src[0] / src[2]
+        sy = src[1] / src[2]
+        inb = ((sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) &
+               (sy <= h - 0.5) & (gx <= nw - 1.0 + 1e-4))
+        sxc = jnp.clip(sx, 0, w - 1)
+        syc = jnp.clip(sy, 0, h - 1)
+        x0 = jnp.floor(sxc).astype(jnp.int32)
+        y0 = jnp.floor(syc).astype(jnp.int32)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        wx = sxc - x0
+        wy = syc - y0
+        img = x[bi]
+        v = (img[:, y0, x0] * ((1 - wy) * (1 - wx))[None] +
+             img[:, y1i, x0] * (wy * (1 - wx))[None] +
+             img[:, y0, x1i] * ((1 - wy) * wx)[None] +
+             img[:, y1i, x1i] * (wy * wx)[None])
+        return jnp.where(inb[None], v, 0.0)
+
+    return {'Out': jax.vmap(one_roi)(rois, batch_idx)}

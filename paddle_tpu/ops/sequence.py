@@ -380,3 +380,84 @@ def lstm_unit(ctx, ins, attrs):
         jax.nn.sigmoid(i) * jnp.tanh(g)
     h = jax.nn.sigmoid(o) * jnp.tanh(c)
     return {'C': c, 'H': h}
+
+
+@register('beam_search')
+def beam_search(ctx, ins, attrs):
+    """One step of beam search, dense formulation.
+
+    Ref: paddle/fluid/operators/beam_search_op.cc + math/beam_search.cc.  The
+    reference shrinks/grows beams via LoD levels; on TPU the beam width stays
+    static: every source keeps exactly `beam_size` rows, finished rows keep
+    re-selecting (end_id, pre_score) as their only candidate (exactly the
+    reference's finished-branch rule, math/beam_search.cc:241-246).  At the
+    first step the caller makes only beam 0 live by feeding pre_scores of
+    [0, -inf, -inf, ...] per source (the LoD equivalent in the reference).
+    """
+    pre_ids = ins['pre_ids']          # (R, 1) int
+    pre_scores = ins['pre_scores']    # (R, 1) float
+    scores = ins['scores']            # (R, K) float
+    ids = ins.get('ids')              # (R, K) int or None -> arange
+    beam = int(attrs['beam_size'])
+    end_id = int(attrs['end_id'])
+    acc = bool(attrs.get('is_accumulated', True))
+    R, K = scores.shape
+    batch = -(-R // beam)  # rows are padded up so any R builds (the batch
+    # dim is a -1 placeholder during shape inference; real runs have
+    # R % beam == 0 and the pad is empty)
+    pad = batch * beam - R
+    if ids is None:
+        ids = jnp.broadcast_to(jnp.arange(K, dtype=pre_ids.dtype), (R, K))
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+    cand = scores if acc else pre_scores + jnp.log(
+        jnp.maximum(scores, jnp.finfo(scores.dtype).tiny))
+    finished = (pre_ids[:, 0] == end_id)[:, None]              # (R, 1)
+    only_slot0 = jnp.arange(K)[None, :] == 0
+    cand = jnp.where(finished, jnp.where(only_slot0, pre_scores, neg_inf),
+                     cand)
+    cand_ids = jnp.where(finished, end_id, ids)
+    if pad:
+        cand = jnp.pad(cand, [(0, pad), (0, 0)], constant_values=-jnp.inf)
+        cand_ids = jnp.pad(cand_ids, [(0, pad), (0, 0)],
+                           constant_values=end_id)
+    flat_scores = cand.reshape(batch, beam * K)
+    flat_ids = cand_ids.reshape(batch, beam * K)
+    top_v, top_i = jax.lax.top_k(flat_scores, beam)            # (batch, beam)
+    parent_in_src = top_i // K                                 # beam index
+    sel_ids = jnp.take_along_axis(flat_ids, top_i, axis=1)
+    parent_idx = (jnp.arange(batch)[:, None] * beam + parent_in_src)
+    return {'selected_ids': sel_ids.reshape(-1, 1)[:R],
+            'selected_scores': top_v.reshape(-1, 1)[:R].astype(scores.dtype),
+            'parent_idx': jnp.minimum(parent_idx.reshape(-1)[:R],
+                                      R - 1).astype(jnp.int32)}
+
+
+@register('beam_search_decode')
+def beam_search_decode(ctx, ins, attrs):
+    """Backtrace beam-search steps into full hypotheses.
+
+    Ref: paddle/fluid/operators/beam_search_decode_op.cc.  The reference
+    walks LoD back-pointers on the CPU; here the per-step parent indices are
+    an explicit dense input and the walk is a lax.scan from the last step —
+    one compiled gather chain, shapes static.
+
+    Inputs: Ids (T, R, 1), Scores (T, R, 1), Parents (T, R) int32.
+    Outputs: SentenceIds (R, T), SentenceScores (R, T); positions after a
+    hypothesis' end token hold end_id / its final score.
+    """
+    ids = ins['Ids'][:, :, 0]        # (T, R)
+    scores = ins['Scores'][:, :, 0]  # (T, R)
+    T, R = ids.shape
+    parents = ins.get('Parents')     # (T, R); identity when omitted
+    if parents is None:
+        parents = jnp.broadcast_to(jnp.arange(R, dtype=jnp.int32), (T, R))
+
+    def step(src, t):
+        tok = ids[t, src]
+        sc = scores[t, src]
+        nxt = parents[t, src]
+        return nxt, (tok, sc)
+
+    _, (toks, scs) = jax.lax.scan(step, jnp.arange(R), jnp.arange(T),
+                                  reverse=True)
+    return {'SentenceIds': toks.T, 'SentenceScores': scs.T}

@@ -32,7 +32,7 @@ def top2_gating(logits, capacity):
     # to e> . <mean gate prob of e>
     density = mask1.mean(axis=1)                             # [G,E]
     density_proxy = probs.mean(axis=1)
-    aux = (density * density_proxy).sum(axis=-1).mean() * (E * E)
+    aux = (density * density_proxy).sum(axis=-1).mean() * E
 
     # positions within each expert's capacity buffer (running count)
     pos1 = (jnp.cumsum(mask1, axis=1) - mask1)               # [G,S,E]

@@ -1,0 +1,283 @@
+"""ROI pooling family, tree_conv, conv_shift, beam search.
+
+Model: reference tests/unittests/test_roi_pool_op.py, test_psroi_pool_op.py,
+test_tree_conv_op.py, test_beam_search_op.py, test_beam_search_decode_op.py.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_roi_pool_and_align_build_run():
+    x = layers.data('x', shape=[3, 8, 8], dtype='float32')
+    rois = layers.data('rois', shape=[4], dtype='float32',
+                       append_batch_size=False, stop_gradient=True)
+    rois2 = layers.reshape(rois, [-1, 4])
+    p = layers.roi_pool(x, rois2, pooled_height=2, pooled_width=2,
+                        spatial_scale=1.0)
+    a = layers.roi_align(x, rois2, pooled_height=2, pooled_width=2,
+                         spatial_scale=1.0)
+    xv = np.arange(2 * 3 * 8 * 8, dtype='float32').reshape(2, 3, 8, 8)
+    rv = np.array([[0, 0, 3, 3], [2, 2, 7, 7]], 'float32')
+    rp, ra = _run([p, a], {'x': xv, 'rois': rv})
+    assert rp.shape == (2, 3, 2, 2)
+    assert ra.shape == (2, 3, 2, 2)
+    # max pool of roi (0,0,3,3) bottom-right 2x2 block of a 4x4 region:
+    # rows 2..3, cols 2..3 of channel 0 image 0 -> max = 3*8+3 = 27
+    assert rp[0, 0, 1, 1] == 27.0
+
+
+def test_psroi_pool_uniform_input():
+    oc, ph, pw = 2, 2, 2
+    c = oc * ph * pw
+    x = layers.data('x', shape=[c, 6, 6], dtype='float32')
+    rois = layers.data('rois', shape=[1, 4], dtype='float32',
+                       append_batch_size=False, stop_gradient=True)
+    out = layers.psroi_pool(x, rois, oc, 1.0, ph, pw)
+    # each input channel k holds constant value k -> output bin (i,j) of
+    # out-channel csel equals the constant of channel (csel*ph+i)*pw+j
+    xv = np.broadcast_to(
+        np.arange(c, dtype='float32')[None, :, None, None],
+        (1, c, 6, 6)).copy()
+    rv = np.array([[0, 0, 5, 5]], 'float32')
+    r, = _run([out], {'x': xv, 'rois': rv})
+    assert r.shape == (1, oc, ph, pw)
+    for csel in range(oc):
+        for i in range(ph):
+            for j in range(pw):
+                assert r[0, csel, i, j] == (csel * ph + i) * pw + j
+
+
+def test_conv_shift_matches_numpy():
+    x = layers.data('x', shape=[5], dtype='float32')
+    y = layers.data('y', shape=[3], dtype='float32')
+    out = layers.conv_shift(x, y)
+    xv = np.random.RandomState(0).randn(2, 5).astype('float32')
+    yv = np.random.RandomState(1).randn(2, 3).astype('float32')
+    r, = _run([out], {'x': xv, 'y': yv})
+    m, n = 5, 3
+    half = n // 2
+    want = np.zeros_like(xv)
+    for b in range(2):
+        for i in range(m):
+            for j in range(n):
+                want[b, i] += xv[b, (i + j - half) % m] * yv[b, j]
+    np.testing.assert_allclose(r, want, rtol=1e-5)
+
+
+def _tree_conv_numpy(nodes, edges, W, max_depth):
+    """Direct DFS re-implementation of tree2col.cc for checking."""
+    B, N, F = nodes.shape
+    _, three, out_size, nf = W.shape[1], W.shape[1], W.shape[2], W.shape[3]
+    W2 = W.reshape(3 * W.shape[0], -1)
+    out = np.zeros((B, N, W.shape[2], W.shape[3]), nodes.dtype)
+    for b in range(B):
+        tr = {}
+        node_count = 0
+        for (u, v) in edges[b]:
+            if u == 0 or v == 0:
+                break
+            tr.setdefault(int(u), []).append(int(v))
+            node_count += 1
+        node_count += 1
+        for root in range(1, node_count + 1):
+            # DFS patch: (node, index(1-based), pclen, depth)
+            patch = [(root, 1, 1, 0)]
+            stack = [(root, 1, 1, 0)]
+            visited = {root}
+            while stack:
+                u, _, _, d = stack[-1]
+                advanced = False
+                for i, v in enumerate(tr.get(u, [])):
+                    if v not in visited and d + 1 < max_depth:
+                        visited.add(v)
+                        sz = len(tr[u])
+                        stack.append((v, i, sz, d + 1))
+                        patch.append((v, i + 1, sz, d + 1))
+                        advanced = True
+                if not advanced:
+                    stack.pop()
+            row = np.zeros((F, 3), nodes.dtype)
+            for (v, idx, pclen, d) in patch:
+                eta_t = (max_depth - d) / max_depth
+                tmp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+                eta_l = (1 - eta_t) * tmp
+                eta_r = (1 - eta_t) * (1 - eta_l)
+                f = nodes[b, v - 1]
+                row[:, 0] += eta_l * f
+                row[:, 1] += eta_r * f
+                row[:, 2] += eta_t * f
+            out[b, root - 1] = (row.reshape(1, 3 * F) @ W2).reshape(
+                W.shape[2], W.shape[3])
+    return out
+
+
+def test_tree_conv_matches_reference_dfs():
+    B, N, F, E = 2, 6, 4, 5
+    rs = np.random.RandomState(0)
+    nodes_np = rs.randn(B, N, F).astype('float32')
+    # tree: 1 -> 2,3 ; 2 -> 4,5 ; 3 -> 6 (1-based)
+    edges_np = np.tile(np.array(
+        [[1, 2], [1, 3], [2, 4], [2, 5], [3, 6]], 'int32'), (B, 1, 1))
+    nodes = layers.data('nodes', shape=[N, F], dtype='float32')
+    edges = layers.data('edges', shape=[E, 2], dtype='int32',
+                        stop_gradient=True)
+    out = layers.tree_conv(nodes, edges, output_size=3, num_filters=2,
+                           max_depth=2, act=None, bias_attr=False)
+    prog = fluid.default_main_program()
+    w_name = [p for p in prog.global_block().all_parameters()][0].name
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    r, = exe.run(feed={'nodes': nodes_np, 'edges': edges_np},
+                 fetch_list=[out])
+    W = np.array(fluid.global_scope().get(w_name))
+    want = _tree_conv_numpy(nodes_np, edges_np, W, max_depth=2)
+    np.testing.assert_allclose(r, want, rtol=1e-4, atol=1e-5)
+
+
+def test_beam_search_step_and_decode():
+    beam, K, end_id = 2, 2, 0
+    pre_ids = layers.data('pre_ids', shape=[2, 1], dtype='int64',
+                          append_batch_size=False, stop_gradient=True)
+    pre_scores = layers.data('pre_scores', shape=[2, 1], dtype='float32',
+                             append_batch_size=False, stop_gradient=True)
+    ids = layers.data('ids', shape=[2, 2], dtype='int64',
+                      append_batch_size=False, stop_gradient=True)
+    scores = layers.data('scores', shape=[2, 2], dtype='float32',
+                         append_batch_size=False, stop_gradient=True)
+    sid, ssc, par = layers.beam_search(pre_ids, pre_scores, ids, scores,
+                                       beam_size=beam, end_id=end_id,
+                                       return_parent_idx=True)
+    # one source, two beams; beam 0 candidates (5:0.9, 6:0.3),
+    # beam 1 candidates (7:0.8, 8:0.6) -> top2 overall: 0.9 (id5,p0), 0.8(7,p1)
+    r_ids, r_sc, r_par = _run(
+        [sid, ssc, par],
+        {'pre_ids': np.array([[1], [2]], 'int64'),
+         'pre_scores': np.array([[0.1], [0.2]], 'float32'),
+         'ids': np.array([[5, 6], [7, 8]], 'int64'),
+         'scores': np.array([[0.9, 0.3], [0.8, 0.6]], 'float32')})
+    assert r_ids[:, 0].tolist() == [5, 7]
+    np.testing.assert_allclose(r_sc[:, 0], [0.9, 0.8], rtol=1e-6)
+    assert r_par.tolist() == [0, 1]
+
+
+def test_beam_search_finished_beam_propagates_end_id():
+    pre_ids = layers.data('pre_ids', shape=[2, 1], dtype='int64',
+                          append_batch_size=False, stop_gradient=True)
+    pre_scores = layers.data('pre_scores', shape=[2, 1], dtype='float32',
+                             append_batch_size=False, stop_gradient=True)
+    ids = layers.data('ids', shape=[2, 2], dtype='int64',
+                      append_batch_size=False, stop_gradient=True)
+    scores = layers.data('scores', shape=[2, 2], dtype='float32',
+                         append_batch_size=False, stop_gradient=True)
+    sid, ssc = layers.beam_search(pre_ids, pre_scores, ids, scores,
+                                  beam_size=2, end_id=0)
+    # beam 0 already finished (pre_id==0) with score 5.0 -> must survive as
+    # (0, 5.0); beam 1 contributes its best live candidate
+    r_ids, r_sc = _run(
+        [sid, ssc],
+        {'pre_ids': np.array([[0], [2]], 'int64'),
+         'pre_scores': np.array([[5.0], [0.2]], 'float32'),
+         'ids': np.array([[5, 6], [7, 8]], 'int64'),
+         'scores': np.array([[0.9, 0.3], [1.5, 0.6]], 'float32')})
+    assert r_ids[0, 0] == 0
+    np.testing.assert_allclose(r_sc[0, 0], 5.0)
+    assert r_ids[1, 0] == 7
+
+
+def test_beam_search_decode_backtrace():
+    from paddle_tpu.layers import control_flow as cf
+    T, R = 3, 2
+    ids_feed = {}
+    ids_arr = cf.create_array('int64')
+    sc_arr = cf.create_array('float32')
+    par_arr = cf.create_array('int32')
+    for t in range(T):
+        iv = layers.data('ids%d' % t, shape=[R, 1], dtype='int64',
+                         append_batch_size=False, stop_gradient=True)
+        sv = layers.data('sc%d' % t, shape=[R, 1], dtype='float32',
+                         append_batch_size=False, stop_gradient=True)
+        pv = layers.data('par%d' % t, shape=[R], dtype='int32',
+                         append_batch_size=False, stop_gradient=True)
+        cf.array_write(iv, t, ids_arr)
+        cf.array_write(sv, t, sc_arr)
+        cf.array_write(pv, t, par_arr)
+    sids, sscs = layers.beam_search_decode(ids_arr, sc_arr, beam_size=R,
+                                           end_id=0, parents=par_arr)
+    # step ids:   t0 [10, 20]  t1 [11, 21]  t2 [12, 22]
+    # parents:    t0 [0, 1]    t1 [1, 0]    t2 [0, 1]
+    # final row0: t2 token 12, parent 0 -> t1 token 11, parent 1 -> t0 20
+    feed = {'ids0': np.array([[10], [20]], 'int64'),
+            'ids1': np.array([[11], [21]], 'int64'),
+            'ids2': np.array([[12], [22]], 'int64'),
+            'sc0': np.zeros((R, 1), 'float32'),
+            'sc1': np.zeros((R, 1), 'float32'),
+            'sc2': np.zeros((R, 1), 'float32'),
+            'par0': np.array([0, 1], 'int32'),
+            'par1': np.array([1, 0], 'int32'),
+            'par2': np.array([0, 1], 'int32')}
+    r_ids, r_sc = _run([sids, sscs], feed)
+    assert r_ids.shape == (R, T)
+    assert r_ids[0].tolist() == [20, 11, 12]
+    assert r_ids[1].tolist() == [10, 21, 22]
+
+
+def test_roi_perspective_transform_identity_quad():
+    x = layers.data('x', shape=[1, 4, 4], dtype='float32')
+    rois = layers.data('rois', shape=[1, 8], dtype='float32',
+                       append_batch_size=False, stop_gradient=True)
+    from paddle_tpu.layers import detection
+    out = detection.roi_perspective_transform(x, rois, 4, 4, 1.0)
+    xv = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    # quad == whole image corners (clockwise from top-left)
+    rv = np.array([[0, 0, 3, 0, 3, 3, 0, 3]], 'float32')
+    r, = _run([out], {'x': xv, 'rois': rv})
+    np.testing.assert_allclose(r[0, 0], xv[0, 0], atol=1e-3)
+
+
+def test_beam_search_dynamic_batch_dim_builds():
+    """Regression: dynamic (-1) row count must build (shape-inference
+    placeholders are not divisible by beam_size)."""
+    pre_ids = layers.data('pre_ids', shape=[1], dtype='int64',
+                          stop_gradient=True)
+    pre_scores = layers.data('pre_scores', shape=[1], dtype='float32',
+                             stop_gradient=True)
+    scores = layers.data('scores', shape=[3], dtype='float32',
+                         stop_gradient=True)
+    sid, ssc = layers.beam_search(pre_ids, pre_scores, None, scores,
+                                  beam_size=4, end_id=0)
+    r_ids, r_sc = _run(
+        [sid, ssc],
+        {'pre_ids': np.full((4, 1), 1, 'int64'),
+         'pre_scores': np.array([[0.], [-1e9], [-1e9], [-1e9]], 'float32'),
+         'scores': np.tile(np.array([[0.5, 2.0, 1.0]], 'float32'), (4, 1))})
+    assert r_ids.shape == (4, 1)
+    assert r_ids[0, 0] == 1  # argmax candidate of the only live beam
+
+
+def test_beam_search_decode_without_parents_is_identity():
+    from paddle_tpu.layers import control_flow as cf
+    ids_arr = cf.create_array('int64')
+    sc_arr = cf.create_array('float32')
+    for t in range(2):
+        iv = layers.data('i%d' % t, shape=[1], dtype='int64',
+                         stop_gradient=True)
+        sv = layers.data('s%d' % t, shape=[1], dtype='float32',
+                         stop_gradient=True)
+        cf.array_write(iv, t, ids_arr)
+        cf.array_write(sv, t, sc_arr)
+    sids, _ = layers.beam_search_decode(ids_arr, sc_arr, beam_size=2,
+                                        end_id=0)
+    r, = _run([sids], {'i0': np.array([[3], [4]], 'int64'),
+                       'i1': np.array([[5], [6]], 'int64'),
+                       's0': np.zeros((2, 1), 'float32'),
+                       's1': np.zeros((2, 1), 'float32')})
+    assert r[0].tolist() == [3, 5]
+    assert r[1].tolist() == [4, 6]
