@@ -52,6 +52,10 @@ from .transpiler import (DistributeTranspiler,  # noqa
                          DistributeTranspilerConfig, memory_optimize,
                          release_memory, InferenceTranspiler)
 from . import dataset  # noqa
+from . import imperative  # noqa
+from . import debugger  # noqa
+from . import inference  # noqa
+from . import train  # noqa
 
 
 def memory_optimize_hint(*a, **k):

@@ -25,6 +25,10 @@ __all__ = [
     'get_flags', 'set_flags',
 ]
 
+# Imperative (dygraph) mode: slot holds the active _ImperativeState while
+# inside imperative.guard(); Block.append_op then executes ops eagerly.
+_imperative = [None]
+
 # ---------------------------------------------------------------- places
 
 class Place(object):
@@ -165,6 +169,36 @@ class Variable(object):
         self.is_data = is_data
         self.type = type or 'lod_tensor'
         self.op = None  # producer op
+        self._ivalue = None      # imperative mode: concrete jax.Array
+        self._grad_value = None  # imperative mode: last computed gradient
+
+    # ------- imperative (dygraph) API: value/grad access on eager vars -----
+    def numpy(self):
+        if self._ivalue is None:
+            raise ValueError('var %s holds no eager value (imperative mode '
+                             'only)' % self.name)
+        return np.asarray(self._ivalue)
+
+    _numpy = numpy
+
+    def backward(self):
+        from ..imperative import base as _imp_base
+        _imp_base.eager_backward(self)
+
+    _backward = backward
+
+    def gradient(self):
+        if self._grad_value is None:
+            raise ValueError('var %s has no gradient (call backward first)'
+                             % self.name)
+        return np.asarray(self._grad_value)
+
+    _gradient = gradient
+
+    def clear_gradient(self):
+        self._grad_value = None
+
+    _clear_gradient = clear_gradient
 
     @property
     def dtype(self):
@@ -432,6 +466,13 @@ class Block(object):
     def create_parameter(self, name=None, shape=None, dtype='float32', **kw):
         if name is None:
             name = unique_name.generate('_param')
+        if _imperative[0] is not None:
+            # eager mode: a same-named initialized parameter is reused, so a
+            # Layer's repeated forward calls share weights across iterations
+            existing = self.program.blocks[0].vars.get(name)
+            if isinstance(existing, Parameter) and \
+                    existing._ivalue is not None:
+                return existing
         p = Parameter(self, shape=shape, dtype=dtype, name=name, **kw)
         # parameters always live in the global (root) block, like the ref
         root = self.program.blocks[0]
@@ -455,7 +496,10 @@ class Block(object):
             ov = self._find_var_recursive(n)
             if ov is not None:
                 ov.op = op
-        if infer_shape and registry.has_op(type):
+        if _imperative[0] is not None:
+            from ..imperative import base as _imp_base
+            _imp_base.eager_run_op(op)
+        elif infer_shape and registry.has_op(type):
             self._infer_shapes(op)
         return op
 

@@ -98,6 +98,9 @@ class LayerHelper(object):
         shape = [int(d) for d in shape]
         param = self.block.create_parameter(
             shape=shape, dtype=dtype, **attr.to_kwargs())
+        if framework._imperative[0] is not None and \
+                param._ivalue is not None:
+            return param  # eager reuse: already initialized on a prior call
         attr.initializer(param)
         return param
 

@@ -1,0 +1,115 @@
+"""Program debugging / visualization.
+
+Parity: reference python/paddle/fluid/debugger.py (pprint_program_codes,
+draw_block_graphviz) and graphviz.py.  Emits human-readable program listings
+and Graphviz .dot files without needing the graphviz binary.
+"""
+import os
+import re
+
+from .core.framework import Parameter, Program
+
+__all__ = ['pprint_program_codes', 'pprint_block_codes',
+           'draw_block_graphviz', 'program_to_code']
+
+_RESERVED = re.compile(r'[^A-Za-z0-9_]')
+
+
+def _code_of_var(v):
+    flags = []
+    if isinstance(v, Parameter):
+        flags.append('param')
+    elif v.persistable:
+        flags.append('persist')
+    if v.stop_gradient:
+        flags.append('stop_grad')
+    if v.lod_level:
+        flags.append('lod=%d' % v.lod_level)
+    return '%s : %s%s %s' % (v.name, v.dtype, list(v.shape or ()),
+                             ','.join(flags))
+
+
+def _code_of_op(op):
+    ins = ', '.join('%s=[%s]' % (slot, ', '.join(names))
+                    for slot, names in sorted(op.inputs.items()))
+    outs = ', '.join('%s=[%s]' % (slot, ', '.join(names))
+                     for slot, names in sorted(op.outputs.items()))
+    attrs = {k: v for k, v in op.attrs.items() if k != 'op_role'}
+    astr = ''
+    if attrs:
+        astr = ' {%s}' % ', '.join(
+            '%s=%r' % (k, _short(v)) for k, v in sorted(attrs.items()))
+    return '{%s} = %s(%s)%s' % (outs, op.type, ins, astr)
+
+
+def _short(v):
+    s = repr(v)
+    return v if len(s) <= 60 else s[:57] + '...'
+
+
+def pprint_block_codes(block, show_backward=True):
+    lines = ['block[%d] parent=%d {' % (block.idx, block.parent_idx)]
+    for name in sorted(block.vars):
+        lines.append('  var  ' + _code_of_var(block.vars[name]))
+    for op in block.ops:
+        lines.append('  op   ' + _code_of_op(op))
+    lines.append('}')
+    return '\n'.join(lines)
+
+
+def program_to_code(program):
+    return '\n'.join(pprint_block_codes(b) for b in program.blocks)
+
+
+def pprint_program_codes(program, stream=None):
+    code = program_to_code(program)
+    if stream is None:
+        print(code)
+    else:
+        stream.write(code + '\n')
+    return code
+
+
+def draw_block_graphviz(block, highlights=None, path='./graph.dot'):
+    """Write a Graphviz dot file of the block's op/var dataflow graph."""
+    highlights = set(highlights or ())
+
+    def vid(name):
+        return 'var_' + _RESERVED.sub('_', name)
+
+    lines = ['digraph G {', '  rankdir=TB;']
+    seen_vars = set()
+
+    def emit_var(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        v = block._find_var_recursive(name)
+        shape = list(v.shape or ()) if v is not None else '?'
+        color = ('red' if name in highlights else
+                 'lightblue' if isinstance(v, Parameter) else 'white')
+        lines.append(
+            '  %s [label="%s\\n%s" shape=oval style=filled '
+            'fillcolor=%s];' % (vid(name), name, shape, color))
+
+    for i, op in enumerate(block.ops):
+        oid = 'op_%d' % i
+        lines.append('  %s [label="%s" shape=box style=filled '
+                     'fillcolor=lightgrey];' % (oid, op.type))
+        for n in op.input_names():
+            emit_var(n)
+            lines.append('  %s -> %s;' % (vid(n), oid))
+        for n in op.output_names():
+            emit_var(n)
+            lines.append('  %s -> %s;' % (oid, vid(n)))
+    lines.append('}')
+    dot = '\n'.join(lines)
+    if path:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, 'w') as f:
+            f.write(dot)
+    return dot
+
+
+def draw_program_graphviz(program, path='./graph.dot'):
+    return draw_block_graphviz(program.global_block(), path=path)

@@ -1,0 +1,15 @@
+"""paddle_tpu.imperative — dygraph/eager mode.
+
+Parity: reference python/paddle/fluid/imperative/__init__.py.
+"""
+from . import base
+from .base import enabled, guard, to_variable, no_record  # noqa: F401
+from . import layers
+from .layers import Layer, PyLayer  # noqa: F401
+from . import nn
+from .nn import Conv2D, Pool2D, FC, BatchNorm, Embedding  # noqa: F401
+
+__all__ = []
+__all__ += base.__all__
+__all__ += layers.__all__
+__all__ += nn.__all__

@@ -1,0 +1,442 @@
+"""Imperative (dygraph) mode — eager op-by-op execution with tape autograd.
+
+Parity: reference python/paddle/fluid/imperative/base.py (enabled, guard,
+to_variable) + the C++ imperative tracer (paddle/fluid/imperative/tracer.cc).
+
+TPU-native design: instead of a C++ tracer that records per-op grad-op nodes,
+eager mode executes each appended op's JAX impl immediately (JAX dispatches
+eagerly outside jit) and records the op on a flat tape.  `var.backward()`
+replays the tape as a pure function of the leaf variables (Parameters and
+`to_variable` inputs) under `jax.vjp`, so gradients come from XLA-native AD —
+the exact same impls used by the graph executor, no hand-written grad kernels.
+"""
+import contextlib
+
+import numpy as np
+
+from ..core import framework
+from ..core import registry
+from ..core import unique_name
+from ..core.framework import Parameter, Variable
+
+__all__ = ['enabled', 'guard', 'to_variable', 'no_record']
+
+_CONTROL_FLOW = {'while', 'conditional_block'}
+
+
+class _OpEntry(object):
+    """One executed op on the tape: (op, stable rng index)."""
+
+    __slots__ = ('op', 'idx')
+
+    def __init__(self, op, idx):
+        self.op = op
+        self.idx = idx
+
+    @property
+    def in_names(self):
+        return self.op.input_names()
+
+    @property
+    def out_names(self):
+        return self.op.output_names()
+
+    def lookup(self, name):
+        return self.op.block._find_var_recursive(name)
+
+    def run(self, env, ctx_factory):
+        import jax.lax as lax
+        import jax.numpy as jnp
+        op = self.op
+        impl = registry.get_op(op.type).impl
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = [env[n] for n in names]
+            ins[slot] = vals if op.input_is_list[slot] else vals[0]
+        outs = impl(ctx_factory(self.idx, op), ins, op.attrs) or {}
+        for slot, names in op.outputs.items():
+            if slot not in outs:
+                continue
+            vals = outs[slot]
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            for name, val in zip(names, vals):
+                if val is None:
+                    continue
+                var = self.lookup(name)
+                if var is not None and var.stop_gradient and hasattr(
+                        val, 'dtype') and jnp.issubdtype(
+                            val.dtype, jnp.floating):
+                    val = lax.stop_gradient(val)
+                env[name] = val
+
+
+class _PyLayerEntry(object):
+    """A PyLayer call on the tape: host-side numpy forward/backward, lowered
+    with jax.pure_callback + jax.custom_vjp at replay time."""
+
+    __slots__ = ('cls', 'in_names', 'out_names', 'out_specs', 'block')
+
+    def __init__(self, cls, in_names, out_names, out_specs, block):
+        self.cls = cls
+        self.in_names = in_names
+        self.out_names = out_names
+        self.out_specs = out_specs  # list of ShapeDtypeStruct
+        self.block = block
+
+    def lookup(self, name):
+        return self.block._find_var_recursive(name)
+
+    def run(self, env, ctx_factory):
+        import jax
+        cls = self.cls
+        specs = self.out_specs
+
+        @jax.custom_vjp
+        def f(*xs):
+            return jax.pure_callback(
+                lambda *a: _as_tuple(cls.forward([np.asarray(x) for x in a]),
+                                     len(specs)),
+                tuple(specs), *xs)
+
+        def fwd(*xs):
+            ys = f(*xs)
+            return ys, (xs, ys)
+
+        def bwd(res, cts):
+            xs, ys = res
+            if len(xs) != 1 or len(specs) != 1:
+                raise NotImplementedError(
+                    'PyLayer backward supports one input/one output '
+                    '(parity with the reference v1.3 PyLayer)')
+            in_spec = jax.ShapeDtypeStruct(np.shape(xs[0]), xs[0].dtype)
+            gx = jax.pure_callback(
+                lambda x, y, ct: np.asarray(
+                    cls.backward([np.asarray(x), np.asarray(y),
+                                  np.asarray(ct)]),
+                    dtype=in_spec.dtype).reshape(in_spec.shape),
+                in_spec, xs[0], ys[0], cts[0])
+            return (gx,)
+
+        f.defvjp(fwd, bwd)
+        ys = f(*[env[n] for n in self.in_names])
+        for name, val in zip(self.out_names, ys):
+            env[name] = val
+
+
+def _as_tuple(x, n):
+    if isinstance(x, (list, tuple)):
+        return tuple(np.asarray(v) for v in x)
+    assert n == 1
+    return (np.asarray(x),)
+
+
+class _ImperativeState(object):
+    def __init__(self, main_prog, startup_prog, seed):
+        import jax
+        self.main_prog = main_prog
+        self.startup_prog = startup_prog
+        self.base_key = jax.random.key(seed)
+        self.tape = []
+        self.op_counter = 0
+        self.no_record_depth = 0
+
+    # ---- rng context for one eager/replayed op (mirrors registry.OpCtx)
+    def ctx(self, idx, op):
+        return _EagerOpCtx(self, idx, op)
+
+    def next_index(self):
+        i = self.op_counter
+        self.op_counter += 1
+        return i
+
+
+class _EagerOpCtx(object):
+    is_infer = False
+
+    def __init__(self, state, op_index, op):
+        self._state = state
+        self.op_index = op_index
+        self.op = op
+
+    def rng(self, n=0):
+        import jax
+        return jax.random.fold_in(self._state.base_key,
+                                  self.op_index * 1009 + n)
+
+
+def _state():
+    return framework._imperative[0]
+
+
+def enabled():
+    return _state() is not None
+
+
+@contextlib.contextmanager
+def guard(place=None, seed=0):
+    """Enable imperative mode (parity: reference imperative/base.py guard).
+    Fresh main/startup programs scope the eagerly-built graph."""
+    prog = framework.Program()
+    startup = framework.Program()
+    with framework.program_guard(prog, startup):
+        with unique_name.guard():
+            st = _ImperativeState(prog, startup, seed)
+            framework._imperative[0] = st
+            try:
+                yield
+            finally:
+                framework._imperative[0] = None
+
+
+@contextlib.contextmanager
+def no_record():
+    """Execute eagerly but keep ops off the tape (used for optimizer updates,
+    which must not be differentiated through on the next backward)."""
+    st = _state()
+    if st is None:
+        yield
+        return
+    st.no_record_depth += 1
+    try:
+        yield
+    finally:
+        st.no_record_depth -= 1
+
+
+def to_variable(value, block=None):
+    """Wrap a numpy array as an eager Variable (autograd leaf)."""
+    import jax.numpy as jnp
+    st = _state()
+    if st is None:
+        raise RuntimeError('to_variable must be called under '
+                           'imperative.guard()')
+    if isinstance(value, Variable):
+        return value
+    arr = jnp.asarray(value)
+    if block is None:
+        block = st.main_prog.global_block()
+    var = block.create_var(
+        name=unique_name.generate('tmp_ivar'),
+        shape=tuple(arr.shape), dtype=str(arr.dtype))
+    var._ivalue = arr
+    var._eager_leaf = True
+    var.stop_gradient = not jnp.issubdtype(arr.dtype, jnp.floating)
+    return var
+
+
+# ------------------------------------------------------------------ exec
+
+
+def eager_run_op(op):
+    """Execute a just-appended op immediately; called from Block.append_op."""
+    st = _state()
+    if op.type in _CONTROL_FLOW:
+        raise NotImplementedError(
+            'op %s: graph control flow is not supported in imperative mode; '
+            'use Python control flow directly' % op.type)
+    impl = registry.get_op(op.type).impl
+    ins = {}
+    env = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            v = op.block._find_var_recursive(n)
+            if v is None or getattr(v, '_ivalue', None) is None:
+                raise ValueError(
+                    'imperative: input var %s of op %s has no value '
+                    '(was it fed via to_variable or produced eagerly?)'
+                    % (n, op.type))
+            env[n] = v._ivalue
+            vals.append(v._ivalue)
+        ins[slot] = vals if op.input_is_list[slot] else vals[0]
+    idx = st.next_index()
+    try:
+        outs = impl(st.ctx(idx, op), ins, op.attrs) or {}
+    except Exception:
+        _drop_op(op)
+        raise
+    for slot, names in op.outputs.items():
+        if slot not in outs:
+            continue
+        vals = outs[slot]
+        vals = vals if isinstance(vals, (list, tuple)) else [vals]
+        for name, val in zip(names, vals):
+            if val is None:
+                continue
+            var = op.block._find_var_recursive(name)
+            if var is None:
+                continue
+            var._ivalue = val
+            var.shape = tuple(int(d) for d in val.shape)
+            # mirror param init values onto the real Parameter (initializers
+            # write to a same-named mirror var in the startup program)
+            if op.block.program is st.startup_prog:
+                real = st.main_prog.global_block()._find_var_recursive(name)
+                if real is not None:
+                    real._ivalue = val
+                    real.shape = tuple(int(d) for d in val.shape)
+    if _should_record(st, op):
+        st.tape.append(_OpEntry(op, idx))
+    elif op.block.program is st.main_prog:
+        # unrecorded main-program ops (optimizer updates under no_record,
+        # persistable-only writers) would otherwise pile up one per step
+        _drop_op(op)
+
+
+def _drop_op(op):
+    ops = op.block.ops
+    if ops and ops[-1] is op:
+        ops.pop()
+    else:  # pragma: no cover - defensive; append_op always puts it last
+        try:
+            ops.remove(op)
+        except ValueError:
+            pass
+
+
+def _should_record(st, op):
+    if st.no_record_depth > 0:
+        return False
+    if op.block.program is not st.main_prog:
+        return False  # startup init ops are not part of the autograd graph
+    if op.attrs.get('op_role') == framework.OpRole.Optimize:
+        return False
+    outs = [op.block._find_var_recursive(n) for n in op.output_names()]
+    outs = [v for v in outs if v is not None]
+    if outs and all(v.persistable for v in outs):
+        return False  # writes only persistable state (lr vars, counters)
+    return True
+
+
+def record_pylayer(cls, in_vars, out_vars):
+    import jax
+    st = _state()
+    if st is None or st.no_record_depth > 0:
+        return
+    specs = [jax.ShapeDtypeStruct(tuple(v._ivalue.shape), v._ivalue.dtype)
+             for v in out_vars]
+    st.tape.append(_PyLayerEntry(
+        cls, [v.name for v in in_vars], [v.name for v in out_vars], specs,
+        st.main_prog.global_block()))
+
+
+# -------------------------------------------------------------- backward
+
+
+def _is_leaf(v):
+    if v.stop_gradient:
+        return False
+    if isinstance(v, Parameter):
+        return v.trainable
+    return getattr(v, '_eager_leaf', False)
+
+
+def eager_backward(target):
+    """Compute d(target)/d(leaves) by replaying the tape under jax.vjp.
+    Gradients are stored on each leaf's `_grad_value` (fresh, not
+    accumulated — v1.3 semantics).  Clears the tape afterwards."""
+    import jax
+    import jax.numpy as jnp
+
+    st = _state()
+    if st is None:
+        raise RuntimeError('backward() outside imperative.guard()')
+    entries = st.tape
+    # classify inputs in tape order: a name read before any tape op wrote it
+    # is an external input (leaf or constant) — even if a later/same op also
+    # writes it (in-place persistable state like batch_norm moving stats)
+    leaves, consts, leaf_vars = {}, {}, {}
+    produced = set()
+    for e in entries:
+        for n in e.in_names:
+            if n in produced or n in leaves or n in consts:
+                continue
+            v = e.lookup(n)
+            val = None if v is None else getattr(v, '_ivalue', None)
+            if val is None:
+                raise ValueError('imperative backward: missing value for %s'
+                                 % n)
+            if _is_leaf(v):
+                leaves[n] = val
+                leaf_vars[n] = v
+            else:
+                consts[n] = val
+        produced.update(e.out_names)
+
+    tname = target.name
+    if tname not in produced:
+        if _is_leaf(target):  # d target / d target == 1
+            target._grad_value = jnp.ones_like(target._ivalue)
+        _clear_tape(st, leaves, consts)
+        return {target.name: target} if _is_leaf(target) else {}
+
+    def fw(leaf_vals):
+        env = dict(consts)
+        env.update(leaf_vals)
+        for e in entries:
+            e.run(env, st.ctx)
+        return env[tname]
+
+    out, pullback = jax.vjp(fw, leaves)
+    grads, = pullback(jnp.ones_like(out))
+    written = {}
+    for n, g in grads.items():
+        leaf_vars[n]._grad_value = g
+        written[n] = leaf_vars[n]
+    _clear_tape(st, leaves, consts)
+    return written
+
+
+def _clear_tape(st, ext_leaves=(), ext_consts=()):
+    """Drop the tape and prune its temporaries from the block — including
+    consumed `to_variable` leaves (each pins a batch-sized device array) —
+    so memory stays bounded across training iterations."""
+    dead_ops = set()
+    dead_vars = set()
+    for e in st.tape:
+        if isinstance(e, _OpEntry):
+            dead_ops.add(id(e.op))
+        for n in e.out_names:
+            v = e.lookup(n)
+            if v is not None and not v.persistable and \
+                    not isinstance(v, Parameter):
+                dead_vars.add(n)
+    blk = st.main_prog.global_block()
+    for n in list(ext_leaves) + list(ext_consts):
+        v = blk.vars.get(n)
+        if v is not None and getattr(v, '_eager_leaf', False):
+            dead_vars.add(n)
+    if dead_ops:
+        blk.ops = [op for op in blk.ops if id(op) not in dead_ops]
+    for n in dead_vars:
+        blk.vars.pop(n, None)
+    st.tape = []
+
+
+def eager_params_grads(loss, parameter_list=None, no_grad_set=None):
+    """Optimizer.backward() in imperative mode: run tape backward, then
+    materialize `<param>@GRAD` vars holding the grad values so the optimizer
+    update ops can consume them eagerly.  Only gradients computed by THIS
+    backward are used — a parameter absent from the current loss keeps its
+    old _grad_value for inspection but is not re-updated with it."""
+    st = _state()
+    fresh = eager_backward(loss)
+    root = st.main_prog.global_block()
+    no_grad = set(no_grad_set or ())
+    if parameter_list:
+        params = [root.var(p) if isinstance(p, str) else p
+                  for p in parameter_list]
+    else:
+        params = [v for v in root.vars.values() if isinstance(v, Parameter)]
+    out = []
+    for p in sorted(params, key=lambda v: v.name):
+        if p.name in no_grad or not p.trainable or p.name not in fresh:
+            continue
+        g = p._grad_value
+        if g is None:
+            continue
+        gvar = root.create_var(name=p.name + '@GRAD', shape=tuple(p.shape),
+                               dtype=p.dtype, stop_gradient=True)
+        gvar._ivalue = g
+        out.append((p, gvar))
+    return out

@@ -1,0 +1,156 @@
+"""Layer / PyLayer base classes for imperative mode.
+
+Parity: reference python/paddle/fluid/imperative/layers.py (Layer with
+_build_once lazy build, PyLayer with numpy forward/backward).
+"""
+import collections
+
+import numpy as np
+
+from ..core import unique_name
+from ..core.framework import Parameter, Variable
+from . import base
+
+__all__ = ['Layer', 'PyLayer']
+
+
+class Layer(object):
+    """Composable eager module.  Subclasses implement `forward`; parameters
+    created through sub-layers are discovered via attribute registration."""
+
+    def __init__(self, name_scope=None, dtype='float32'):
+        self._full_name = unique_name.generate(
+            name_scope if name_scope else
+            self.__class__.__name__.lower())
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._once_built = False
+
+    def full_name(self):
+        return self._full_name
+
+    # ------------------------------------------------------------ params
+    def parameters(self, include_sublayers=True):
+        ret = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                ret.extend(l.parameters(include_sublayers=True))
+        return ret
+
+    def sublayers(self, include_sublayers=True):
+        ret = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                ret.extend(l.sublayers(include_sublayers=True))
+        return ret
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def state_dict(self, include_sublayers=True):
+        d = collections.OrderedDict()
+        for p in self.parameters(include_sublayers):
+            d[p.name] = p.numpy()
+        return d
+
+    def set_dict(self, state, include_sublayers=True):
+        import jax.numpy as jnp
+        for p in self.parameters(include_sublayers):
+            if p.name in state:
+                p._ivalue = jnp.asarray(state[p.name])
+
+    def train(self):
+        self._is_test = False
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self._is_test = True
+        for l in self._sub_layers.values():
+            l.eval()
+
+    # ------------------------------------------------------------- call
+    def _build_once(self, *args, **kwargs):
+        pass
+
+    def __call__(self, *inputs, **kwargs):
+        if not self._once_built:
+            self._build_once(*inputs, **kwargs)
+            self._once_built = True
+        return self.forward(*inputs, **kwargs)
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __setattr__(self, name, value):
+        params = self.__dict__.get('_parameters')
+        layers = self.__dict__.get('_sub_layers')
+        if isinstance(value, Parameter) and params is not None:
+            params[name] = value
+        elif isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        # only hit for names missing from __dict__
+        params = self.__dict__.get('_parameters')
+        if params and name in params:
+            return params[name]
+        layers = self.__dict__.get('_sub_layers')
+        if layers and name in layers:
+            return layers[name]
+        raise AttributeError(name)
+
+
+class PyLayer(object):
+    """Custom host-side op: numpy `forward(inputs)` and
+    `backward([inp, out, d_out])` static methods (parity: reference
+    imperative/layers.py PyLayer).  Lowered through jax.pure_callback with a
+    custom VJP on tape replay."""
+
+    def __init__(self):
+        pass
+
+    @staticmethod
+    def forward(inputs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(douts):
+        raise NotImplementedError
+
+    @classmethod
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError('call a PyLayer instance, not the class')
+
+    def __call__(self, *inputs):
+        import jax.numpy as jnp
+        st = base._state()
+        if st is None:
+            raise RuntimeError('PyLayer must run under imperative.guard()')
+        in_vars = [base.to_variable(v) if not isinstance(v, Variable) else v
+                   for v in inputs]
+        ins_np = [np.asarray(v._ivalue) for v in in_vars]
+        outs = type(self).forward(ins_np)
+        outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+        block = st.main_prog.global_block()
+        out_vars = []
+        for o in outs:
+            arr = jnp.asarray(o)
+            var = block.create_var(
+                name=unique_name.generate('pylayer_out'),
+                shape=tuple(arr.shape), dtype=str(arr.dtype))
+            var._ivalue = arr
+            out_vars.append(var)
+        base.record_pylayer(type(self), in_vars, out_vars)
+        return out_vars

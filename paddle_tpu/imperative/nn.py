@@ -1,0 +1,169 @@
+"""Imperative layer classes (parity: reference imperative/nn.py — Conv2D,
+Pool2D, FC, BatchNorm; Embedding added as the natural fifth).
+
+Each instance pins its parameter names, so repeated forward calls reuse the
+same (already-initialized) Parameters — the eager analogue of the reference's
+`_build_once` parameter caching.
+"""
+import copy
+
+from ..param_attr import ParamAttr
+from . import layers as imp_layers
+
+__all__ = ['Conv2D', 'Pool2D', 'FC', 'BatchNorm', 'Embedding']
+
+
+def _pin(attr, name):
+    """Give an (optional) ParamAttr a stable name so the parameter is reused
+    across forward calls."""
+    if attr is False:
+        return False
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return False
+    attr = copy.deepcopy(attr)
+    if attr.name is None:
+        attr.name = name
+    return attr
+
+
+class _FluidLayer(imp_layers.Layer):
+    """Base for imperative layers implemented by calling paddle_tpu.layers.*
+    in forward (ops execute eagerly under imperative.guard)."""
+
+    def _track_params(self):
+        # parameters land in the program's root block under pinned names
+        from ..core.framework import Parameter, default_main_program
+        root = default_main_program().global_block()
+        prefix = self._full_name + '.'
+        for name, v in root.vars.items():
+            if isinstance(v, Parameter) and name.startswith(prefix):
+                self._parameters.setdefault(name, v)
+
+
+class Conv2D(_FluidLayer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=None, use_cudnn=True,
+                 act=None, param_attr=None, bias_attr=None, name=None,
+                 dtype='float32'):
+        super(Conv2D, self).__init__(name_scope=name or 'conv2d', dtype=dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._act = act
+        self._param_attr = _pin(param_attr, self._full_name + '.w_0')
+        self._bias_attr = _pin(bias_attr, self._full_name + '.b_0')
+
+    def forward(self, input):
+        from .. import layers
+        out = layers.conv2d(
+            input, self._num_filters, self._filter_size, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            groups=self._groups, param_attr=self._param_attr,
+            bias_attr=self._bias_attr, act=self._act)
+        self._track_params()
+        return out
+
+
+class Pool2D(imp_layers.Layer):
+    def __init__(self, pool_size=-1, pool_type='max', pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, name=None,
+                 dtype='float32'):
+        super(Pool2D, self).__init__(name_scope=name or 'pool2d', dtype=dtype)
+        self._pool_size = pool_size
+        self._pool_type = pool_type
+        self._pool_stride = pool_stride
+        self._pool_padding = pool_padding
+        self._global_pooling = global_pooling
+        self._ceil_mode = ceil_mode
+        self._exclusive = exclusive
+
+    def forward(self, input):
+        from .. import layers
+        return layers.pool2d(
+            input, pool_size=self._pool_size, pool_type=self._pool_type,
+            pool_stride=self._pool_stride, pool_padding=self._pool_padding,
+            global_pooling=self._global_pooling, ceil_mode=self._ceil_mode,
+            exclusive=self._exclusive)
+
+
+class FC(_FluidLayer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 num_flatten_dims=1, dtype='float32', act=None, name=None):
+        super(FC, self).__init__(name_scope=name or 'fc', dtype=dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._act = act
+        self._param_attr = _pin(param_attr, self._full_name + '.w_0')
+        self._bias_attr = _pin(bias_attr, self._full_name + '.b_0')
+
+    def forward(self, input):
+        from .. import layers
+        out = layers.fc(input, self._size,
+                        num_flatten_dims=self._num_flatten_dims,
+                        param_attr=self._param_attr,
+                        bias_attr=self._bias_attr, act=self._act)
+        self._track_params()
+        return out
+
+
+class BatchNorm(_FluidLayer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype='float32', data_layout='NCHW', in_place=False,
+                 name=None, moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=False, fuse_with_relu=False,
+                 use_global_stats=False):
+        super(BatchNorm, self).__init__(name_scope=name or 'batch_norm',
+                                        dtype=dtype)
+        self._act = act
+        self._is_test = is_test
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_layout = data_layout
+        self._use_global_stats = use_global_stats
+        self._param_attr = _pin(param_attr, self._full_name + '.w_0')
+        self._bias_attr = _pin(bias_attr, self._full_name + '.b_0')
+        self._moving_mean_name = (moving_mean_name or
+                                  self._full_name + '.mean')
+        self._moving_variance_name = (moving_variance_name or
+                                      self._full_name + '.var')
+
+    def forward(self, input):
+        from .. import layers
+        out = layers.batch_norm(
+            input, act=self._act, is_test=self._is_test,
+            momentum=self._momentum, epsilon=self._epsilon,
+            param_attr=self._param_attr, bias_attr=self._bias_attr,
+            data_layout=self._data_layout,
+            moving_mean_name=self._moving_mean_name,
+            moving_variance_name=self._moving_variance_name,
+            use_global_stats=self._use_global_stats)
+        self._track_params()
+        return out
+
+
+class Embedding(_FluidLayer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype='float32',
+                 name=None):
+        super(Embedding, self).__init__(name_scope=name or 'embedding',
+                                        dtype=dtype)
+        self._size = size
+        self._is_sparse = is_sparse
+        self._padding_idx = padding_idx
+        self._param_attr = _pin(param_attr, self._full_name + '.w_0')
+        self._dtype = dtype
+
+    def forward(self, input):
+        from .. import layers
+        out = layers.embedding(
+            input, self._size, is_sparse=self._is_sparse,
+            padding_idx=self._padding_idx, param_attr=self._param_attr,
+            dtype=self._dtype)
+        self._track_params()
+        return out

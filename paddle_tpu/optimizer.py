@@ -120,9 +120,15 @@ class Optimizer(object):
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
+        from .imperative import base as _imp_base
+        if _imp_base.enabled():
+            return _imp_base.eager_params_grads(loss, parameter_list,
+                                                no_grad_set)
         return append_backward(loss, parameter_list, no_grad_set, callbacks)
 
     def apply_gradients(self, params_grads):
+        if not params_grads:
+            return []
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
@@ -143,9 +149,16 @@ class Optimizer(object):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from .imperative import base as _imp_base
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
-        optimize_ops = self.apply_gradients(params_grads)
+        if _imp_base.enabled():
+            # eager: update ops run immediately on param._ivalue; keep them
+            # off the tape so the next backward doesn't differentiate them
+            with _imp_base.no_record():
+                optimize_ops = self.apply_gradients(params_grads)
+        else:
+            optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 
 

@@ -1,0 +1,70 @@
+"""RecordIO conversion helpers.
+
+Parity: reference python/paddle/fluid/recordio_writer.py
+(convert_reader_to_recordio_file / _files).  Backed by the native C++ record
+format in native/ (src/datafeed.cc) instead of the reference's recordio/
+library; the feeder_list maps reader tuples onto named slots exactly like the
+reference's DataFeeder path.
+"""
+import contextlib
+
+import numpy as np
+
+from . import native
+
+__all__ = ['convert_reader_to_recordio_file',
+           'convert_reader_to_recordio_files']
+
+
+@contextlib.contextmanager
+def create_recordio_writer(filename, compressor=None, max_num_records=None):
+    w = native.RecordWriter(filename)
+    try:
+        yield w
+    finally:
+        w.close()
+
+
+def _to_sample(item, feeder=None):
+    if feeder is not None:
+        item = feeder.feed([item])
+        return [np.asarray(v) for v in item.values()]
+    return [np.asarray(v) for v in item]
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor=None, max_num_records=1000,
+                                    feed_order=None):
+    """Serialize every sample from the reader into one record file.
+    Returns the number of records written."""
+    n = 0
+    with create_recordio_writer(filename) as w:
+        for item in reader_creator():
+            w.write(_to_sample(item, feeder))
+            n += 1
+    return n
+
+
+def convert_reader_to_recordio_files(filename, batch_per_file,
+                                     reader_creator, feeder=None,
+                                     compressor=None, max_num_records=1000,
+                                     feed_order=None):
+    """Shard the reader's samples into multiple record files,
+    `batch_per_file` records each.  Returns the file list."""
+    fns = []
+    w = None
+    n = 0
+    try:
+        for item in reader_creator():
+            if n % batch_per_file == 0:
+                if w is not None:
+                    w.close()
+                fn = '%s-%05d' % (filename, len(fns))
+                fns.append(fn)
+                w = native.RecordWriter(fn)
+            w.write(_to_sample(item, feeder))
+            n += 1
+    finally:
+        if w is not None:
+            w.close()
+    return fns

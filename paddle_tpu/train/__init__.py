@@ -1,0 +1,4 @@
+from . import checkpoint
+from .checkpoint import CheckpointConfig, Checkpointer  # noqa: F401
+
+__all__ = ['checkpoint', 'CheckpointConfig', 'Checkpointer']
