@@ -176,7 +176,7 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
 
     def run_fn(params, feeds, seed):
         base_key = jax.random.key(seed)
-        ectx = registry.ExecCtx(base_key)
+        ectx = registry.ExecCtx(base_key, mesh=mesh)
         env0 = {}
         env0.update(feeds)
         env0.update(params)

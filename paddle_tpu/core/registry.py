@@ -65,6 +65,7 @@ class InferCtx(object):
     """Context used during build-time shape inference (abstract eval)."""
 
     is_infer = True
+    mesh = None
 
     def __init__(self, op=None):
         self.op = op
@@ -75,12 +76,15 @@ class InferCtx(object):
 
 
 class ExecCtx(object):
-    """Per-run context shared by all ops in one lowered block."""
+    """Per-run context shared by all ops in one lowered block.  `mesh` is
+    the executor's device mesh (None single-chip): mesh-aware ops like
+    ring_attention pick their collective strategy from it."""
 
     is_infer = False
 
-    def __init__(self, base_key):
+    def __init__(self, base_key, mesh=None):
         self.base_key = base_key
+        self.mesh = mesh
 
     def for_op(self, op_index, op):
         return OpCtx(self, op_index, op)
@@ -93,6 +97,10 @@ class OpCtx(object):
         self._exec = exec_ctx
         self.op_index = op_index
         self.op = op
+
+    @property
+    def mesh(self):
+        return self._exec.mesh
 
     def rng(self, n=0):
         return jax.random.fold_in(self._exec.base_key,

@@ -47,7 +47,7 @@ __all__ = [
     'sequence_reshape', 'sequence_scatter', 'sequence_mask',
     'sequence_enumerate', 'sequence_concat', 'sequence_reverse',
     'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'chunk_eval',
-    'flash_attention',
+    'flash_attention', 'ring_attention', 'rms_norm', 'rope',
     'linear_chain_crf', 'crf_decoding', 'one_hot', 'group_norm',
     'teacher_student_sigmoid_loss', 'roi_pool', 'roi_align', 'psroi_pool',
     'conv_shift', 'tree_conv', 'beam_search', 'beam_search_decode',
@@ -1595,6 +1595,47 @@ def flash_attention(q, k, v, causal=False, k_lengths=None, name=None):
         ins['KLength'] = k_lengths
     helper.append_op(type='flash_attention', inputs=ins,
                      outputs={'Out': out}, attrs={'causal': causal})
+    return out
+
+
+def ring_attention(q, k, v, causal=False, axis_name='seq', name=None):
+    """Sequence-parallel exact attention over [B, H, T, D] (long-context
+    path; see ops/attention.py ring_attention_op).  Runs the ppermute ring
+    when the executor mesh has a >1 `axis_name` axis, flash attention
+    otherwise — same program, both scales.  New vs reference."""
+    helper = LayerHelper('ring_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(type='ring_attention', inputs={'Q': q, 'K': k, 'V': v},
+                     outputs={'Out': out},
+                     attrs={'causal': causal, 'axis_name': axis_name})
+    return out
+
+
+def rms_norm(input, param_attr=None, epsilon=1e-6, name=None):
+    """RMS LayerNorm over the last dim (the LLaMA norm).  New vs reference
+    (fluid-era predates RMSNorm); scale param only, no bias/centering."""
+    helper = LayerHelper('rms_norm', name=name, param_attr=param_attr)
+    from ..initializer import Constant
+    d = int(input.shape[-1])
+    scale = helper.create_parameter(helper.param_attr, [d], input.dtype,
+                                    default_initializer=Constant(1.0))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='rms_norm',
+                     inputs={'X': input, 'Scale': scale},
+                     outputs={'Y': out}, attrs={'epsilon': epsilon})
+    return out
+
+
+def rope(input, theta=10000.0, positions=None, name=None):
+    """Rotary position embedding on [B, H, T, D] head tensors.  New vs
+    reference (additive add_position_encoding is the fluid-era analogue)."""
+    helper = LayerHelper('rope', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {'X': input}
+    if positions is not None:
+        ins['Positions'] = positions
+    helper.append_op(type='rope', inputs=ins, outputs={'Out': out},
+                     attrs={'theta': float(theta)})
     return out
 
 

@@ -15,3 +15,4 @@ from . import transformer  # noqa
 from . import ctr  # noqa
 from . import word2vec  # noqa
 from . import simple  # noqa
+from . import llama  # noqa

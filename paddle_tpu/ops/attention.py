@@ -22,31 +22,44 @@ _NEG_INF = -1e30
 
 
 def _ref_attention(q, k, v, causal, scale, k_len=None):
-    scores = jnp.einsum('bhqd,bhkd->bhqk', q, k) * scale
-    Tq, Tk = q.shape[2], k.shape[2]
+    """q: [B, H, Tq, D]; k/v: [B, Hkv, Tk, D] with H % Hkv == 0 (GQA —
+    each kv head serves H/Hkv query heads without materializing copies)."""
+    B, H, Tq, D = q.shape
+    Hkv, Tk = k.shape[1], k.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Hkv, g, Tq, D)
+    scores = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k) * scale
     if causal:
         mask = np.tril(np.ones((Tq, Tk), np.bool_), k=Tk - Tq)
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     if k_len is not None:
         kmask = jnp.arange(Tk)[None, :] < k_len[:, None]   # [B, Tk]
-        scores = jnp.where(kmask[:, None, None, :], scores, _NEG_INF)
+        scores = jnp.where(kmask[:, None, None, None, :], scores, _NEG_INF)
     w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum('bhqk,bhkd->bhqd', w, v)
+    return jnp.einsum('bhgqk,bhkd->bhgqd', w, v).reshape(B, H, Tq, D)
 
 
 def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
                   scale, q_block, seq_len, causal_offset=0):
     from jax.experimental import pallas as pl
 
+    b = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale            # [block_q, d]
     block_q = q.shape[0]
     d = q.shape[-1]
-    klen = klen_ref[0, 0]
+    klen = klen_ref[b]                                  # SMEM scalar prefetch
     m = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l = jnp.zeros((block_q,), jnp.float32)
     acc = jnp.zeros((block_q, d), jnp.float32)
-    num_k = seq_len // block_k
+    # skip K blocks that are entirely invalid: past the padded length, and
+    # (causal) past the last query row of this block
+    num_k = jax.lax.div(klen + block_k - 1, block_k)
+    if causal:
+        q_end = causal_offset + (qi + 1) * q_block
+        num_k = jnp.minimum(num_k,
+                            jax.lax.div(q_end + block_k - 1, block_k))
+    num_k = jnp.minimum(num_k, seq_len // block_k)
 
     def body(ki, carry):
         m, l, acc = carry
@@ -76,7 +89,8 @@ def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, causal,
 
 def flash_attention(q, k, v, causal=False, scale=None, k_len=None,
                     block_q=128, block_k=128, interpret=None):
-    """q,k,v: [B, H, T, D]; k_len: optional int32 [B] valid K lengths.
+    """q: [B, H, T, D]; k/v: [B, Hkv, T, D] (Hkv may divide H — GQA/MQA,
+    served without repeating K/V); k_len: optional int32 [B] valid lengths.
 
     Differentiable: forward runs the pallas kernel; the VJP currently uses
     the composed formulation's gradient (a pallas backward kernel is the
@@ -109,7 +123,8 @@ def flash_attention(q, k, v, causal=False, scale=None, k_len=None,
 def _flash_forward(q, k, v, k_len, causal, scale, block_q=128, block_k=128,
                    interpret=None):
     B, H, Tq, D = q.shape
-    Tk = k.shape[2]
+    Hkv, Tk = k.shape[1], k.shape[2]
+    g = H // Hkv
     if interpret is None:
         interpret = jax.default_backend() != 'tpu'
     block_q = min(block_q, Tq)
@@ -118,23 +133,36 @@ def _flash_forward(q, k, v, k_len, causal, scale, block_q=128, block_k=128,
         return _ref_attention(q, k, v, causal, scale, k_len)
     try:
         from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
         qr = q.reshape(B * H, Tq, D)
-        kr = k.reshape(B * H, Tk, D)
-        vr = v.reshape(B * H, Tk, D)
-        klr = jnp.repeat(k_len.astype(jnp.int32), H).reshape(B * H, 1)
+        kr = k.reshape(B * Hkv, Tk, D)
+        vr = v.reshape(B * Hkv, Tk, D)
+        klr = jnp.repeat(k_len.astype(jnp.int32), H)     # [B*H]
         kernel = functools.partial(
             _flash_kernel, block_k=block_k, causal=causal, scale=scale,
             q_block=block_q, seq_len=Tk, causal_offset=Tk - Tq)
-        out = pl.pallas_call(
-            kernel,
+
+        def kv_row(b, i, kl):
+            # GQA: query row b = bi*H + h reads kv row bi*Hkv + h//g, so
+            # K/V stay at Hkv width in HBM — no materialized head copies
+            return (b // H) * Hkv + (b % H) // g, 0, 0
+
+        # k-lengths ride SMEM scalar prefetch (a (1,1) VMEM block would
+        # violate the TPU (8,128) tiling minimum and refuse to lower)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
             grid=(B * H, Tq // block_q),
             in_specs=[
-                pl.BlockSpec((1, 1), lambda b, i: (b, 0)),
-                pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, i, kl: (b, i, 0)),
+                pl.BlockSpec((1, Tk, D), kv_row),
+                pl.BlockSpec((1, Tk, D), kv_row),
             ],
-            out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda b, i, kl: (b, i, 0)),
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
             interpret=interpret,
         )(klr, qr, kr, vr)
@@ -162,3 +190,25 @@ def flash_attention_op(ctx, ins, attrs):
     return {'Out': flash_attention(
         q, k, v, causal=attrs.get('causal', False),
         scale=attrs.get('scale', None), k_len=k_len)}
+
+
+@register('ring_attention')
+def ring_attention_op(ctx, ins, attrs):
+    """Sequence-parallel exact attention (long-context path).
+
+    When the executor runs with a mesh whose 'seq' axis is >1, the op runs
+    the ppermute ring from parallel/ring_attention.py — each device holds
+    T/n_seq of K/V, so context length scales with the ring size.  On a
+    single chip (or no seq axis) it lowers to flash attention: the SAME
+    program serves both, chosen at lowering time from ctx.mesh."""
+    q, k, v = ins['Q'], ins['K'], ins['V']
+    causal = attrs.get('causal', False)
+    scale = attrs.get('scale', None)
+    mesh = getattr(ctx, 'mesh', None)
+    axis = attrs.get('axis_name', 'seq')
+    if mesh is not None and axis in mesh.axis_names and \
+            mesh.shape[axis] > 1 and q.shape[2] % mesh.shape[axis] == 0:
+        from ..parallel.ring_attention import ring_attention
+        return {'Out': ring_attention(q, k, v, mesh, axis_name=axis,
+                                      causal=causal, scale=scale)}
+    return {'Out': flash_attention(q, k, v, causal=causal, scale=scale)}

@@ -536,3 +536,42 @@ def tree_conv(ctx, ins, attrs):
         return out.reshape(N, out_size, nf)
 
     return {'Out': jax.vmap(one)(nodes, edges)}
+
+
+@register('rms_norm')
+def rms_norm(ctx, ins, attrs):
+    """Root-mean-square LayerNorm (no mean-centering, no bias) — the LLaMA
+    norm.  New vs reference (it predates RMSNorm); fused by XLA into the
+    surrounding matmuls."""
+    x = ins['X']
+    w = ins.get('Scale')
+    eps = attrs.get('epsilon', 1e-6)
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    if w is not None:
+        out = out * w.astype(jnp.float32)
+    return {'Y': out.astype(dt)}
+
+
+@register('rope')
+def rope(ctx, ins, attrs):
+    """Rotary position embedding on [B, H, T, D] (D even): rotate feature
+    pairs by position-dependent angles.  theta: base frequency (LLaMA-3
+    uses 500000).  `Positions` (optional int [B, T]) overrides 0..T-1."""
+    x = ins['X']
+    theta = attrs.get('theta', 10000.0)
+    B, H, T, D = x.shape
+    pos = ins.get('Positions')
+    if pos is None:
+        pos = jnp.arange(T)[None, :]                       # [1, T]
+    freqs = theta ** (-jnp.arange(0, D // 2) * 2.0 / D)    # [D/2]
+    ang = pos[:, None, :, None].astype(jnp.float32) * freqs  # [B,1,T,D/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    out = jnp.stack([r1, r2], axis=-1).reshape(B, H, T, D)
+    return {'Out': out.astype(x.dtype)}

@@ -26,11 +26,16 @@ _NEG = -1e30
 
 
 def _local_ring_attention(q, k, v, axis_name, causal, scale):
-    """Per-shard body. q,k,v: [B, H, Tl, D] local blocks; Tl = T / n_dev."""
+    """Per-shard body. q: [B, H, Tl, D]; k/v: [B, Hkv, Tl, D] local blocks
+    (Tl = T / n_dev).  Hkv may divide H (GQA): K/V blocks rotate the ring
+    at Hkv width — the repeated-head view is never materialized, so ICI
+    traffic per hop stays at the grouped size."""
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, H, Tl, D = q.shape
-    q = q * scale
+    Hkv = k.shape[1]
+    g = H // Hkv
+    qg = (q * scale).reshape(B, Hkv, g, Tl, D)
 
     # global positions of this device's query rows
     q_pos = idx * Tl + jnp.arange(Tl)  # [Tl]
@@ -40,17 +45,17 @@ def _local_ring_attention(q, k, v, axis_name, causal, scale):
         # k_blk arrived from device (idx + i) mod n
         src = (idx + i) % n
         k_pos = src * Tl + jnp.arange(Tl)  # [Tl]
-        s = jnp.einsum('bhqd,bhkd->bhqk', q, k_blk,
+        s = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k_blk,
                        preferred_element_type=jnp.float32)
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]  # [Tl, Tl]
-            s = jnp.where(mask[None, None], s, _NEG)
-        m_new = jnp.maximum(m, s.max(axis=-1))          # [B,H,Tl]
+            s = jnp.where(mask[None, None, None], s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))          # [B,Hkv,g,Tl]
         p = jnp.exp(s - m_new[..., None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
-            'bhqk,bhkd->bhqd', p.astype(v_blk.dtype), v_blk,
+            'bhgqk,bhkd->bhgqd', p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32)
         # rotate K/V to the next device (neighbour hop on ICI)
         perm = [(j, (j - 1) % n) for j in range(n)]
@@ -58,13 +63,13 @@ def _local_ring_attention(q, k, v, axis_name, causal, scale):
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros((B, H, Tl, D), jnp.float32)
-    m0 = jnp.full((B, H, Tl), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, Tl), jnp.float32)
+    o0 = jnp.zeros((B, Hkv, g, Tl, D), jnp.float32)
+    m0 = jnp.full((B, Hkv, g, Tl), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, Hkv, g, Tl), jnp.float32)
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
                                   jnp.arange(n))
     out = o / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    return out.reshape(B, H, Tl, D).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name='seq', causal=False,
