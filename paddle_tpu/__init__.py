@@ -56,6 +56,20 @@ from . import imperative  # noqa
 from . import debugger  # noqa
 from . import inference  # noqa
 from . import train  # noqa
+from . import average  # noqa
+from . import evaluator  # noqa
+from . import contrib  # noqa
+from . import trainer  # noqa
+from . import inferencer  # noqa
+from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
+    BeginStepEvent, EndStepEvent, CheckpointConfig  # noqa
+from .inferencer import Inferencer  # noqa
+from . import annotations  # noqa
+from . import net_drawer  # noqa
+from . import recordio_writer  # noqa
+from . import async_executor  # noqa
+from .async_executor import AsyncExecutor  # noqa
+from .data_feed_desc import DataFeedDesc  # noqa
 
 
 def memory_optimize_hint(*a, **k):

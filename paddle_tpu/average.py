@@ -1,0 +1,38 @@
+"""WeightedAverage (parity: reference python/paddle/fluid/average.py).
+
+Host-side running weighted mean over fetched batch metrics.
+"""
+import numpy as np
+
+__all__ = ['WeightedAverage']
+
+
+def _is_number_or_matrix(x):
+    return isinstance(x, (int, float, np.ndarray)) or np.isscalar(x)
+
+
+class WeightedAverage(object):
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError('value must be a number or ndarray')
+        if not _is_number_or_matrix(weight):
+            raise ValueError('weight must be a number or ndarray')
+        value = np.mean(np.asarray(value, dtype='float64'))
+        weight = float(np.sum(np.asarray(weight, dtype='float64')))
+        if self.numerator is None:
+            self.numerator = 0.0
+            self.denominator = 0.0
+        self.numerator += value * weight
+        self.denominator += weight
+
+    def eval(self):
+        if not self.denominator:
+            raise ValueError('nothing accumulated — call add() first')
+        return self.numerator / self.denominator

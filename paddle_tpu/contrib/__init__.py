@@ -1,0 +1,17 @@
+"""paddle_tpu.contrib — high-level Trainer/Inferencer + utilities.
+
+Parity: reference python/paddle/fluid/contrib/ (trainer.py, inferencer.py,
+memory_usage_calc.py, op_frequence.py).
+"""
+from . import trainer
+from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,  # noqa
+                      BeginStepEvent, EndStepEvent, CheckpointConfig)
+from . import inferencer
+from .inferencer import Inferencer  # noqa
+from .memory_usage_calc import memory_usage  # noqa
+from .op_frequence import op_freq_statistic  # noqa
+
+__all__ = []
+__all__ += trainer.__all__
+__all__ += inferencer.__all__
+__all__ += ['memory_usage', 'op_freq_statistic']

@@ -1,0 +1,38 @@
+"""High-level Inferencer (parity: reference contrib/inferencer.py)."""
+import numpy as np
+
+from ..core import framework
+from ..core.executor import Executor, Scope, scope_guard
+from .. import io as fluid_io
+
+__all__ = ['Inferencer']
+
+
+class Inferencer(object):
+    """infer_func() builds the inference graph and returns the prediction
+    Variable(s); params load from `param_path` (a save_params /
+    save_persistables dir)."""
+
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self.scope = Scope()
+        self.inference_program = framework.Program()
+        startup = framework.Program()
+        with framework.program_guard(self.inference_program, startup):
+            out = infer_func()
+            self.predict_vars = list(out) if isinstance(
+                out, (list, tuple)) else [out]
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            fluid_io.load_persistables(self.exe, param_path,
+                                       self.inference_program)
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError('inputs must be a dict of {var_name: ndarray}')
+        with scope_guard(self.scope):
+            results = self.exe.run(
+                self.inference_program, feed=inputs,
+                fetch_list=[v.name for v in self.predict_vars],
+                return_numpy=return_numpy)
+        return results

@@ -1,0 +1,26 @@
+"""Estimate a program's per-batch activation memory (parity: reference
+contrib/memory_usage_calc.py memory_usage)."""
+import numpy as np
+
+from ..core.dtypes import convert_dtype
+
+__all__ = ['memory_usage']
+
+_GB = 1 << 30
+
+
+def memory_usage(program, batch_size):
+    """Rough lower bound: sum of var sizes with the batch dim filled in.
+    XLA's actual peak is usually lower (buffer reuse, fusion) — this
+    mirrors the reference's estimate semantics for capacity planning."""
+    if batch_size <= 0:
+        raise ValueError('batch_size must be positive')
+    total = 0
+    for var in program.list_vars():
+        if var.shape is None:
+            continue
+        n = 1
+        for d in var.shape:
+            n *= batch_size if d in (-1, None) else int(d)
+        total += n * np.dtype(convert_dtype(var.dtype)).itemsize
+    return total / _GB, 'GB'

@@ -1,0 +1,21 @@
+"""Op frequency statistics (parity: reference contrib/op_frequence.py)."""
+from collections import Counter, OrderedDict
+
+__all__ = ['op_freq_statistic']
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_op_freq): single-op counts and adjacent
+    op-pair counts over the whole program."""
+    uni = Counter()
+    adj = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] += 1
+            if prev is not None:
+                adj['%s->%s' % (prev, op.type)] += 1
+            prev = op.type
+    uni_sorted = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, adj_sorted

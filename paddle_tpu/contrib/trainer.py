@@ -1,0 +1,151 @@
+"""High-level Trainer (parity: reference contrib/trainer.py — the book
+chapters' train loop: events, feed_order readers, checkpointing).
+
+TPU-native: the train step is the Executor's single jitted XLA executable;
+the Trainer only owns the epoch/step loop, the event callbacks, and
+checkpoint rotation (train/checkpoint.py), which all stay on the host.
+"""
+import numpy as np
+
+from ..core import framework
+from ..core.executor import Executor, Scope, scope_guard
+from ..data_feeder import DataFeeder
+from .. import io as fluid_io
+from ..train.checkpoint import Checkpointer
+from ..train.checkpoint import CheckpointConfig as _CkptConfig
+
+__all__ = ['Trainer', 'BeginEpochEvent', 'EndEpochEvent', 'BeginStepEvent',
+           'EndStepEvent', 'CheckpointConfig']
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig(_CkptConfig):
+    """Same knobs as the reference contrib CheckpointConfig."""
+
+
+class Trainer(object):
+    """train_func() -> loss Variable (or [loss, ...metrics]) builds the
+    model inside the trainer's programs; optimizer_func() -> Optimizer."""
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+        self.parallel = parallel
+
+        with framework.program_guard(self.train_program,
+                                     self.startup_program):
+            out = train_func()
+            if isinstance(out, (list, tuple)):
+                self.loss = out[0]
+                self.metrics = list(out)
+            else:
+                self.loss = out
+                self.metrics = [out]
+            # test program: forward only, is_test flipped
+            self.test_program = self.train_program.clone(for_test=True)
+            optimizer = optimizer_func()
+            optimizer.minimize(self.loss)
+
+        self.place = place
+        self.exe = Executor(place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                fluid_io.load_persistables(self.exe, param_path,
+                                           self.train_program)
+        self.checkpointer = None
+        if checkpoint_config:
+            self.checkpointer = Checkpointer(checkpoint_config, self.exe,
+                                             self.train_program)
+            with scope_guard(self.scope):
+                meta = self.checkpointer.restore()
+            self._resume_epoch = meta['epoch_id'] if meta else 0
+        else:
+            self._resume_epoch = 0
+        self.__stop = False
+
+    def stop(self):
+        self.__stop = True
+
+    def _feeder(self, feed_order, program):
+        feed_vars = [program.global_block().var(n) for n in feed_order]
+        return DataFeeder(feed_vars, program=program)
+
+    def train(self, num_epochs, event_handler, reader=None,
+              feed_order=None):
+        feeder = self._feeder(feed_order, self.train_program)
+        with scope_guard(self.scope):
+            for epoch_id in range(self._resume_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        if self.checkpointer:
+                            self.checkpointer.save(epoch_id, step_id)
+                        return
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = [m.name for m in self.metrics] \
+                        if begin.fetch_metrics else []
+                    metrics = self.exe.run(self.train_program,
+                                           feed=feeder.feed(data),
+                                           fetch_list=fetch)
+                    if self.checkpointer:
+                        self.checkpointer.maybe_save(epoch_id, step_id)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader, feed_order):
+        feeder = self._feeder(feed_order, self.test_program)
+        accum = None
+        count = 0
+        with scope_guard(self.scope):
+            for data in reader():
+                vals = self.exe.run(self.test_program,
+                                    feed=feeder.feed(data),
+                                    fetch_list=[m.name
+                                                for m in self.metrics])
+                vals = [np.asarray(v, dtype='float64') for v in vals]
+                accum = vals if accum is None else [
+                    a + v for a, v in zip(accum, vals)]
+                count += 1
+        if accum is None:
+            return []
+        return [a / count for a in accum]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, param_path,
+                                       self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with scope_guard(self.scope):
+            fluid_io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.metrics[i] for i in target_var_indexes], self.exe,
+                self.test_program)

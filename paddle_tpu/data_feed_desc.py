@@ -1,0 +1,5 @@
+"""DataFeedDesc (parity: reference fluid/data_feed_desc.py, data_feed.proto)
+— re-exported from the native C++ datafeed pipeline."""
+from .native import DataFeedDesc  # noqa: F401
+
+__all__ = ['DataFeedDesc']

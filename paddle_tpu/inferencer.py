@@ -1,0 +1,5 @@
+"""Deprecated location (parity: reference fluid/inferencer.py) — use
+paddle_tpu.contrib.Inferencer."""
+from .contrib.inferencer import Inferencer  # noqa: F401
+
+__all__ = []

@@ -245,9 +245,27 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.3, evaluate_difficult=True,
                   has_state=None, input_states=None, out_states=None,
-                  ap_version='integral'):
-    raise NotImplementedError(
-        'detection_map: use paddle_tpu.metrics.DetectionMAP (host-side)')
+                  ap_version='integral', detect_count=None,
+                  label_count=None):
+    """Batch mAP (ref layers/detection.py detection_map; op semantics from
+    operators/detection/detection_map_op.h).  detect_res [B, Nd, 6]
+    (label, score, box), label [B, Ng, 5 or 6]; optional per-image counts
+    mask padding.  Cross-batch accumulation lives in
+    evaluator.DetectionMAP."""
+    helper = LayerHelper('detection_map')
+    m = helper.create_variable_for_type_inference('float32')
+    ins = {'DetectRes': detect_res, 'Label': label}
+    if detect_count is not None:
+        ins['DetectCount'] = detect_count
+    if label_count is not None:
+        ins['LabelCount'] = label_count
+    helper.append_op(
+        type='detection_map', inputs=ins, outputs={'MAP': m},
+        attrs={'class_num': class_num, 'background_label': background_label,
+               'overlap_threshold': overlap_threshold,
+               'evaluate_difficult': evaluate_difficult,
+               'ap_version': ap_version})
+    return m
 
 
 def rpn_target_assign(*args, **kwargs):

@@ -1538,8 +1538,30 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None):
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                excluded_chunk_types=None):
-    raise NotImplementedError(
-        'chunk_eval: use paddle_tpu.metrics.ChunkEvaluator (host-side)')
+    """Chunk-level precision/recall/F1 for tagging (ref layers/nn.py
+    chunk_eval; op semantics from operators/chunk_eval_op.h).  Returns
+    (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks)."""
+    helper = LayerHelper('chunk_eval')
+    precision = helper.create_variable_for_type_inference('float32')
+    recall = helper.create_variable_for_type_inference('float32')
+    f1 = helper.create_variable_for_type_inference('float32')
+    num_infer = helper.create_variable_for_type_inference('int64')
+    num_label = helper.create_variable_for_type_inference('int64')
+    num_correct = helper.create_variable_for_type_inference('int64')
+    ins = {'Inference': input, 'Label': label}
+    lv = _len_var(input) or _len_var(label)
+    if lv is not None:
+        ins['SeqLength'] = lv
+    helper.append_op(
+        type='chunk_eval', inputs=ins,
+        outputs={'Precision': precision, 'Recall': recall, 'F1-Score': f1,
+                 'NumInferChunks': num_infer, 'NumLabelChunks': num_label,
+                 'NumCorrectChunks': num_correct},
+        attrs={'chunk_scheme': chunk_scheme,
+               'num_chunk_types': num_chunk_types,
+               'excluded_chunk_types': excluded_chunk_types or []})
+    return (precision, recall, f1, num_infer, num_label, num_correct)
 
 
 def linear_chain_crf(input, label, param_attr=None):

@@ -1,0 +1,9 @@
+"""Graph drawing helpers (parity: reference fluid/net_drawer.py /
+graphviz.py); delegates to debugger's dot export."""
+from .debugger import draw_block_graphviz, draw_program_graphviz  # noqa
+
+__all__ = ['draw_graph', 'draw_block_graphviz', 'draw_program_graphviz']
+
+
+def draw_graph(startup_program, main_program, path='./graph.dot', **kwargs):
+    return draw_program_graphviz(main_program, path=path)

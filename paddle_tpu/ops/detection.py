@@ -546,3 +546,122 @@ def roi_perspective_transform(ctx, ins, attrs):
         return jnp.where(inb[None], v, 0.0)
 
     return {'Out': jax.vmap(one_roi)(rois, batch_idx)}
+
+
+@register('detection_map')
+def detection_map(ctx, ins, attrs):
+    """Batch mAP for detection outputs.
+
+    Parity: reference operators/detection/detection_map_op.h (integral and
+    11point AP).  TPU-native reformulation: fixed-shape batched inputs —
+    DetectRes [B, Nd, 6] (label, score, x1, y1, x2, y2) with DetectCount
+    [B], Label [B, Ng, 6] (label, x1, y1, x2, y2, difficult) with
+    LabelCount [B] — greedy score-order TP assignment runs as a lax.scan
+    per image (carrying the matched-gt mask), then per-class AP via a
+    global sort.  Stateless: returns this batch's mAP (the accumulating
+    pos_count/true_pos/false_pos state of the reference op lives in
+    evaluator.DetectionMAP on the host side).
+    """
+    det = ins['DetectRes']
+    gt = ins['Label']
+    B, Nd = det.shape[0], det.shape[1]
+    Ng = gt.shape[1]
+    n_cls = attrs['class_num']
+    bg = attrs.get('background_label', 0)
+    thresh = attrs.get('overlap_threshold', 0.3)
+    eval_difficult = attrs.get('evaluate_difficult', True)
+    ap_version = attrs.get('ap_version', 'integral')
+    dcount = ins.get('DetectCount')
+    gcount = ins.get('LabelCount')
+    dvalid = (jnp.arange(Nd)[None, :] <
+              (dcount.reshape(B, 1) if dcount is not None
+               else jnp.full((B, 1), Nd)))
+    gvalid = (jnp.arange(Ng)[None, :] <
+              (gcount.reshape(B, 1) if gcount is not None
+               else jnp.full((B, 1), Ng)))
+
+    d_lbl = det[..., 0].astype(jnp.int32)
+    d_scr = jnp.where(dvalid, det[..., 1], -1e9)
+    d_box = det[..., 2:6]
+    g_lbl = gt[..., 0].astype(jnp.int32)
+    g_box = gt[..., 1:5]
+    g_dif = (gt[..., 5] > 0.5) if gt.shape[-1] > 5 else \
+        jnp.zeros((B, Ng), bool)
+    g_dif = g_dif & gvalid
+    if eval_difficult:
+        g_dif = jnp.zeros_like(g_dif)
+
+    def iou(a, b):  # [Nd,4] x [Ng,4] -> [Nd,Ng]
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * \
+            jnp.maximum(a[:, 3] - a[:, 1], 0)
+        area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * \
+            jnp.maximum(b[:, 3] - b[:, 1], 0)
+        return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter,
+                                   1e-10)
+
+    def per_image(dl, ds, db, gl, gb, gdif, gv, dv):
+        ious = iou(db, gb)                                  # [Nd, Ng]
+        order = jnp.argsort(-ds)                            # score desc
+
+        def step(matched, di):
+            ok_cls = (gl == dl[di]) & gv
+            cand = jnp.where(ok_cls & ~matched, ious[di], -1.0)
+            j = jnp.argmax(cand)
+            hit = (cand[j] >= thresh) & dv[di]
+            is_dif = jnp.where(hit, gdif[j], False)
+            matched = matched.at[j].set(matched[j] | hit)
+            tp = hit & ~is_dif
+            # difficult-matched detections are ignored (neither tp nor fp)
+            fp = dv[di] & ~hit
+            return matched, (di, tp, fp)
+
+        _, (idx, tp, fp) = jax.lax.scan(
+            step, jnp.zeros((Ng,), bool), order)
+        # unsort back to detection order
+        tp_o = jnp.zeros((Nd,), bool).at[idx].set(tp)
+        fp_o = jnp.zeros((Nd,), bool).at[idx].set(fp)
+        return tp_o, fp_o
+
+    tp, fp = jax.vmap(per_image)(d_lbl, d_scr, d_box, g_lbl, g_box, g_dif,
+                                 gvalid, dvalid)
+
+    flat_scr = d_scr.reshape(-1)
+    flat_lbl = d_lbl.reshape(-1)
+    flat_tp = tp.reshape(-1)
+    flat_fp = fp.reshape(-1)
+    flat_valid = dvalid.reshape(-1)
+    order = jnp.argsort(-flat_scr)
+    s_lbl = flat_lbl[order]
+    s_tp = flat_tp[order].astype(jnp.float32)
+    s_fp = flat_fp[order].astype(jnp.float32)
+    s_valid = flat_valid[order]
+
+    def class_ap(c):
+        mask = (s_lbl == c) & s_valid
+        tp_c = jnp.cumsum(jnp.where(mask, s_tp, 0.0))
+        fp_c = jnp.cumsum(jnp.where(mask, s_fp, 0.0))
+        npos = ((g_lbl == c) & gvalid & ~g_dif).sum().astype(jnp.float32)
+        recall = tp_c / jnp.maximum(npos, 1.0)
+        precision = tp_c / jnp.maximum(tp_c + fp_c, 1e-10)
+        if ap_version == '11point':
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(lambda t: jnp.max(
+                jnp.where(mask & (recall >= t), precision, 0.0)))(pts)
+            ap = pmax.sum() / 11.0
+        else:
+            prev_r = jnp.concatenate([jnp.zeros(1), recall[:-1]])
+            ap = jnp.where(mask, (recall - prev_r) * precision, 0.0).sum()
+        return jnp.where(npos > 0, ap, -1.0)
+
+    classes = jnp.arange(n_cls)
+    aps = jax.vmap(class_ap)(classes)
+    aps = jnp.where(classes == bg, -1.0, aps)
+    have = aps >= 0
+    m_ap = jnp.where(have.sum() > 0,
+                     jnp.where(have, aps, 0.0).sum() /
+                     jnp.maximum(have.sum(), 1), 0.0)
+    return {'MAP': m_ap.reshape(1).astype(jnp.float32)}

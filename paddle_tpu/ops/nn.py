@@ -575,3 +575,162 @@ def rope(ctx, ins, attrs):
     r2 = x2 * cos + x1 * sin
     out = jnp.stack([r1, r2], axis=-1).reshape(B, H, T, D)
     return {'Out': out.astype(x.dtype)}
+
+
+@register('chunk_eval')
+def chunk_eval(ctx, ins, attrs):
+    """Chunk detection eval (NER-style): counts inferred/label/correct
+    chunks under IOB/IOE/IOBES/plain tag schemes.
+
+    Parity: reference paddle/fluid/operators/chunk_eval_op.h semantics
+    (ChunkBegin/ChunkEnd rule tables), re-expressed as a vectorized
+    position-parallel computation: a chunk is identified by its (start,
+    end, type) triple; starts come from a running max over begin markers,
+    and a correct chunk is an aligned (end, start, type) match — no
+    sequential segment walk, so the whole batch evals in one fused XLA op.
+    """
+    scheme = attrs.get('chunk_scheme', 'IOB')
+    num_chunk_types = attrs['num_chunk_types']
+    excluded = attrs.get('excluded_chunk_types') or []
+    n_tag = {'IOB': 2, 'IOE': 2, 'IOBES': 4, 'plain': 1}[scheme]
+    # tag-type codes per scheme; -1 = not present
+    tb, ti, te, ts = {'IOB': (0, 1, -1, -1), 'IOE': (-1, 0, 1, -1),
+                      'IOBES': (0, 1, 2, 3), 'plain': (-1, -1, -1, -1)}[
+                          scheme]
+    other = num_chunk_types
+
+    inf = ins['Inference']
+    lab = ins['Label']
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    B, T = inf.shape
+    lens = ins.get('SeqLength')
+    if lens is None:
+        lens = jnp.full((B,), T, jnp.int32)
+    lens = lens.reshape(B).astype(jnp.int32)
+    valid = jnp.arange(T)[None, :] < lens[:, None]          # [B, T]
+
+    def marks(tags):
+        ctype = jnp.where(valid, tags // n_tag, other)
+        ttype = tags % n_tag
+        # shift: position 0 sees prev_type = other
+        pt = jnp.concatenate([jnp.full((B, 1), other), ctype[:, :-1]], 1)
+        ptag = jnp.concatenate([jnp.full((B, 1), -1), ttype[:, :-1]], 1)
+        is_other = ctype == other
+        prev_other = pt == other
+        # ChunkBegin(prev, cur) rule table (see reference chunk_eval_op.h)
+        begin = jnp.where(
+            prev_other, ~is_other,
+            jnp.where(is_other, False,
+                      jnp.where(ctype != pt, True,
+                                (ttype == tb) | (ttype == ts) |
+                                (((ttype == ti) | (ttype == te)) &
+                                 ((ptag == te) | (ptag == ts))))))
+        # ChunkEnd(cur, next): close at i when the i+1 transition says so
+        nt = jnp.concatenate([ctype[:, 1:], jnp.full((B, 1), other)], 1)
+        ntag = jnp.concatenate([ttype[:, 1:], jnp.full((B, 1), -1)], 1)
+        end = jnp.where(
+            is_other, False,
+            jnp.where(nt == other, True,
+                      jnp.where(nt != ctype, True,
+                                (ttype == te) | (ttype == ts) |
+                                (((ttype == tb) | (ttype == ti)) &
+                                 ((ntag == tb) | (ntag == ts))))))
+        begin = begin & valid
+        end = end & valid
+        # chunk start position aligned to each index: running max of
+        # begin-marked indices
+        idx = jnp.arange(T)[None, :]
+        start_of = jax.lax.cummax(jnp.where(begin, idx, -1), axis=1)
+        keep = jnp.ones((B, T), bool)
+        for ex in excluded:
+            keep = keep & (ctype != ex)
+        return begin & keep, end & keep, ctype, start_of
+
+    ib, ie, it, istart = marks(inf.astype(jnp.int32))
+    lb, le, lt, lstart = marks(lab.astype(jnp.int32))
+    num_inf = ib.sum()
+    num_lab = lb.sum()
+    correct = (ie & le & (istart == lstart) & (it == lt)).sum()
+
+    num_inf_f = num_inf.astype(jnp.float32)
+    num_lab_f = num_lab.astype(jnp.float32)
+    cor_f = correct.astype(jnp.float32)
+    precision = jnp.where(num_inf_f > 0, cor_f / num_inf_f, 0.0)
+    recall = jnp.where(num_lab_f > 0, cor_f / num_lab_f, 0.0)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall / (precision + recall), 0.0)
+    i64 = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return {'Precision': precision.reshape(1),
+            'Recall': recall.reshape(1),
+            'F1-Score': f1.reshape(1),
+            'NumInferChunks': num_inf.astype(i64).reshape(1),
+            'NumLabelChunks': num_lab.astype(i64).reshape(1),
+            'NumCorrectChunks': correct.astype(i64).reshape(1)}
+
+
+@register('edit_distance')
+def edit_distance(ctx, ins, attrs):
+    """Levenshtein distance between hypothesis and reference id sequences.
+
+    Parity: reference operators/edit_distance_op (CPU/GPU DP kernels).
+    TPU-native: one lax.scan over hypothesis rows; within a row the
+    d[i][j-1] dependency is folded into a prefix-min —
+    row[j] = j + cummin_j(f[j] - j) with f = min(prev+1, shift(prev)+cost)
+    — so each row is a fused vector op instead of a scalar inner loop.
+    """
+    hyps = ins['Hyps']
+    refs = ins['Refs']
+    if hyps.ndim == 3:
+        hyps = hyps[..., 0]
+    if refs.ndim == 3:
+        refs = refs[..., 0]
+    B, Th = hyps.shape
+    Tr = refs.shape[1]
+    hl = ins.get('HypsLength')
+    rl = ins.get('RefsLength')
+    hl = (jnp.full((B,), Th, jnp.int32) if hl is None
+          else hl.reshape(B).astype(jnp.int32))
+    rl = (jnp.full((B,), Tr, jnp.int32) if rl is None
+          else rl.reshape(B).astype(jnp.int32))
+    normalized = attrs.get('normalized', True)
+    ignored = attrs.get('ignored_tokens') or []
+
+    def squeeze_ignored(seq, length):
+        if not ignored:
+            return seq, length
+        keep = jnp.ones(seq.shape, bool)
+        for t in ignored:
+            keep = keep & (seq != t)
+        keep = keep & (jnp.arange(seq.shape[0]) < length)
+        idx = jnp.argsort(~keep, stable=True)  # kept tokens first, in order
+        return seq[idx], keep.sum().astype(jnp.int32)
+
+    def one(h, r, hlen, rlen):
+        h, hlen = squeeze_ignored(h, hlen)
+        r, rlen = squeeze_ignored(r, rlen)
+        j = jnp.arange(Tr + 1)
+        row0 = j.astype(jnp.int32)
+
+        def step(prev, hi):
+            cost = jnp.where(hi == r, 0, 1).astype(jnp.int32)  # [Tr]
+            diag = prev[:-1] + cost
+            up = prev[1:] + 1
+            f = jnp.concatenate([(prev[:1] + 1), jnp.minimum(diag, up)])
+            row = jax.lax.cummin(f - row0) + row0
+            return row, row
+
+        _, rows = jax.lax.scan(step, row0, h)
+        all_rows = jnp.concatenate([row0[None], rows])     # [Th+1, Tr+1]
+        d = all_rows[hlen, rlen].astype(jnp.float32)
+        if normalized:
+            d = d / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+        return d
+
+    out = jax.vmap(one)(hyps.astype(jnp.int32), refs.astype(jnp.int32),
+                        hl, rl)
+    i64 = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return {'Out': out.reshape(B, 1),
+            'SequenceNum': jnp.asarray([B], i64)}

@@ -1,0 +1,7 @@
+"""Deprecated location (parity: reference fluid/trainer.py which forwards
+to contrib) — use paddle_tpu.contrib.Trainer."""
+from .contrib.trainer import (  # noqa: F401
+    Trainer, BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent,
+    CheckpointConfig)
+
+__all__ = []

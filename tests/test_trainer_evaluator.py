@@ -1,0 +1,166 @@
+"""High-level Trainer/Inferencer + evaluator/average tests."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    w = np.array([[1.5], [-2.0], [0.5]], 'float32')
+
+    def r():
+        for _ in range(8):
+            batch = []
+            for _ in range(16):
+                x = rng.rand(3).astype('float32')
+                batch.append((x, (x[None, :] @ w)[0]))
+            yield batch
+    return r
+
+
+def test_trainer_train_test_save_infer(tmp_path):
+    def train_func():
+        x = layers.data('x', shape=[3], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name='w'))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        return loss
+
+    events = {'epochs': 0, 'steps': 0}
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndEpochEvent):
+            events['epochs'] += 1
+        elif isinstance(ev, fluid.EndStepEvent):
+            events['steps'] += 1
+            events['last_loss'] = float(np.asarray(ev.metrics[0]).reshape(()))
+
+    trainer = fluid.Trainer(train_func,
+                            lambda: fluid.optimizer.SGDOptimizer(0.3))
+    # batch reader feeds (x, y) rows in feed_order
+    trainer.train(3, handler, reader=_reader(), feed_order=['x', 'y'])
+    assert events['epochs'] == 3
+    assert events['steps'] == 24
+    test_loss, = trainer.test(_reader(), feed_order=['x', 'y'])
+    assert float(test_loss) < 0.5, test_loss
+
+    pdir = str(tmp_path / 'params')
+    trainer.save_params(pdir)
+
+    def infer_func():
+        x = layers.data('x', shape=[3], dtype='float32')
+        return layers.fc(x, 1, param_attr=fluid.ParamAttr(name='w'))
+
+    inferencer = fluid.Inferencer(infer_func, pdir)
+    xb = np.eye(3, dtype='float32')
+    out, = inferencer.infer({'x': xb})
+    assert out.shape == (3, 1)
+
+
+def test_trainer_checkpoint_resume(tmp_path):
+    def train_func():
+        x = layers.data('x', shape=[3], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1)
+        return layers.reduce_mean(layers.square(pred - y))
+
+    ckpt = fluid.CheckpointConfig(str(tmp_path / 'ck'), step_interval=4)
+    t1 = fluid.Trainer(train_func,
+                       lambda: fluid.optimizer.SGDOptimizer(0.1),
+                       checkpoint_config=ckpt)
+    t1.train(2, lambda ev: None, reader=_reader(), feed_order=['x', 'y'])
+    # a fresh trainer with the same config resumes from the saved epoch
+    t2 = fluid.Trainer(train_func,
+                       lambda: fluid.optimizer.SGDOptimizer(0.1),
+                       checkpoint_config=fluid.CheckpointConfig(
+                           str(tmp_path / 'ck'), step_interval=4))
+    assert t2._resume_epoch >= 1
+
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+    a = WeightedAverage()
+    a.add(2.0, 1.0)
+    a.add(4.0, 3.0)
+    assert abs(a.eval() - 3.5) < 1e-9
+    a.reset()
+    a.add(1.0, 1.0)
+    assert abs(a.eval() - 1.0) < 1e-9
+
+
+def test_chunk_evaluator_accumulates():
+    from paddle_tpu.evaluator import ChunkEvaluator
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = layers.data('inf', shape=[8], dtype='int64')
+        lab = layers.data('lab', shape=[8], dtype='int64')
+        ev = ChunkEvaluator(inf, lab, 'IOB', 3)
+    exe = fluid.Executor()
+    exe.run(startup)
+    ev.reset(exe)
+    inf_np = np.array([[0, 1, 6, 2, 3, 3, 6, 4]], 'int64')
+    lab_np = np.array([[0, 1, 6, 2, 3, 6, 6, 4]], 'int64')
+    for _ in range(3):
+        exe.run(main, feed={'inf': inf_np, 'lab': lab_np},
+                fetch_list=[m.name for m in ev.metrics])
+    p, r, f1 = ev.eval(exe)
+    # per batch: 3 infer/3 label/2 correct, same accumulated ratio
+    assert abs(float(p) - 2 / 3) < 1e-6
+    assert abs(float(r) - 2 / 3) < 1e-6
+    # reset really zeroes
+    ev.reset(exe)
+    p2, r2, f2 = ev.eval(exe)
+    assert float(p2) == 0.0
+
+
+def test_edit_distance_evaluator():
+    from paddle_tpu.evaluator import EditDistance
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = layers.data('hyp', shape=[4], dtype='int64', lod_level=1)
+        ref = layers.data('ref', shape=[4], dtype='int64', lod_level=1)
+        ev = EditDistance(hyp, ref)
+    exe = fluid.Executor()
+    exe.run(startup)
+    ev.reset(exe)
+    hyp_np = np.array([[1, 2, 3, 4], [1, 1, 1, 1]], 'int64')
+    ref_np = np.array([[1, 2, 3, 4], [2, 2, 2, 2]], 'int64')
+    exe.run(main, feed={'hyp': hyp_np, 'ref': ref_np},
+            fetch_list=[m.name for m in ev.metrics])
+    avg, err_rate = ev.eval(exe)
+    assert abs(float(avg) - 2.0) < 1e-6    # (0 + 4)/2
+    assert abs(float(err_rate) - 0.5) < 1e-6
+
+
+def test_detection_map_evaluator():
+    from paddle_tpu.evaluator import DetectionMAP
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = layers.data('d', shape=[3, 6], dtype='float32')
+        g = layers.data('g', shape=[2, 6], dtype='float32')
+        ev = DetectionMAP(d, g, None, class_num=3, overlap_threshold=0.5)
+    exe = fluid.Executor()
+    exe.run(startup)
+    ev.reset(exe)
+    det = np.array([[[1, .9, 0, 0, 1, 1],
+                     [1, .8, 5, 5, 6, 6],
+                     [2, .7, 2, 2, 3, 3]]], 'float32')
+    gt = np.array([[[1, 0, 0, 1, 1, 0],
+                    [2, 2, 2, 3, 3, 0]]], 'float32')
+    exe.run(main, feed={'d': det, 'g': gt},
+            fetch_list=[m.name for m in ev.metrics])
+    assert abs(float(ev.eval(exe)) - 1.0) < 1e-5
+
+
+def test_contrib_utils():
+    from paddle_tpu.contrib import memory_usage, op_freq_statistic
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[3], dtype='float32')
+        y = layers.fc(x, 4)
+        layers.fc(y, 4)
+    gb, unit = memory_usage(main, batch_size=32)
+    assert gb > 0 and unit == 'GB'
+    uni, adj = op_freq_statistic(main)
+    assert uni.get('mul', 0) + uni.get('matmul', 0) >= 2 or uni
