@@ -10,8 +10,10 @@ from . import inferencer
 from .inferencer import Inferencer  # noqa
 from .memory_usage_calc import memory_usage  # noqa
 from .op_frequence import op_freq_statistic  # noqa
+from . import quantize  # noqa
+from .quantize import QuantizeTranspiler  # noqa
 
 __all__ = []
 __all__ += trainer.__all__
 __all__ += inferencer.__all__
-__all__ += ['memory_usage', 'op_freq_statistic']
+__all__ += ['memory_usage', 'op_freq_statistic', 'QuantizeTranspiler']

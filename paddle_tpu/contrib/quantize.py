@@ -1,0 +1,158 @@
+"""Quantization-aware training + inference freezing.
+
+Parity: reference contrib/quantize/quantize_transpiler.py
+(QuantizeTranspiler: training_transpile, freeze_program, convert_to_int8).
+
+TPU-native: fake-quant/dequant pairs are plain registered ops inserted
+before each quantizable op — the straight-through estimator lives in the
+op's JAX impl, and XLA fuses the round/clip/scale chain into the matmul it
+guards, so QAT costs almost nothing on the MXU.  Freezing folds weight
+scales into int8 scope arrays; TPU int8 matmuls feed the MXU directly.
+"""
+import numpy as np
+
+from ..core import unique_name
+from ..core.framework import Operator, Parameter
+
+__all__ = ['QuantizeTranspiler']
+
+_QUANTIZABLE = {'mul', 'matmul', 'conv2d', 'conv2d_transpose'}
+
+
+def _quantized_var_name(n):
+    return '%s.quantized' % n
+
+
+def _quantized_scale_name(n):
+    return '%s.scale' % n
+
+
+class QuantizeTranspiler(object):
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type='abs_max',
+                 weight_quantize_type='abs_max', window_size=10000,
+                 moving_rate=0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        if activation_quantize_type not in (
+                'abs_max', 'range_abs_max', 'moving_average_abs_max'):
+            raise ValueError('unknown activation_quantize_type %s'
+                             % activation_quantize_type)
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.moving_rate = moving_rate
+
+    # ------------------------------------------------------------ train
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake-quant/dequant before every quantizable op's inputs
+        (weights and activations), in place."""
+        from ..core.framework import default_main_program
+        program = program or default_main_program()
+        for block in program.blocks:
+            self._transpile_block(block)
+        program._bump()
+        return program
+
+    def _transpile_block(self, block):
+        new_ops = []
+        quantized = {}  # original name -> quantized name (this block)
+        for op in block.ops:
+            if op.type in _QUANTIZABLE:
+                for slot, names in list(op.inputs.items()):
+                    qnames = []
+                    for n in names:
+                        v = block._find_var_recursive(n)
+                        if v is None or v.dtype not in ('float32',
+                                                        'bfloat16'):
+                            qnames.append(n)
+                            continue
+                        if n not in quantized:
+                            is_w = isinstance(v, Parameter)
+                            qop, qname = self._make_fake_quant(
+                                block, v, is_weight=is_w)
+                            new_ops.append(qop)
+                            quantized[n] = qname
+                        qnames.append(quantized[n])
+                    op.inputs[slot] = qnames
+            new_ops.append(op)
+        block.ops = new_ops
+
+    def _make_fake_quant(self, block, var, is_weight):
+        bits = self.weight_bits if is_weight else self.activation_bits
+        qname = _quantized_var_name(var.name)
+        out = block.create_var(name=qname, shape=var.shape, dtype=var.dtype)
+        scale = block.create_var(
+            name=unique_name.generate(_quantized_scale_name(var.name)),
+            shape=(1,), dtype='float32',
+            persistable=not is_weight and self.act_type != 'abs_max',
+            stop_gradient=True)
+        use_moving = (not is_weight and self.act_type in
+                      ('range_abs_max', 'moving_average_abs_max'))
+        if use_moving:
+            # moving scale state: zero-init, updated in the step itself
+            from ..initializer import Constant
+            Constant(0.0)(scale)
+            op = Operator(
+                block, 'fake_quantize_dequantize_moving_average_abs_max',
+                inputs={'X': var, 'InScale': scale},
+                outputs={'Out': out, 'OutScale': scale},
+                attrs={'bit_length': bits,
+                       'moving_rate': self.moving_rate})
+        else:
+            op = Operator(block, 'fake_quantize_dequantize_abs_max',
+                          inputs={'X': var},
+                          outputs={'Out': out, 'OutScale': scale},
+                          attrs={'bit_length': bits})
+        return op, qname
+
+    # ----------------------------------------------------------- freeze
+    def freeze_program(self, program, place=None, fuse_bn=False,
+                       scope=None):
+        """Turn a QAT program into an inference program: activation
+        fake-quants become no-ops (scales are baked into the weights),
+        weight fake-quants are folded by re-quantizing the trained weights
+        once on the host."""
+        from ..core.executor import global_scope
+        scope = scope or global_scope()
+        rmax = float(2 ** (self.weight_bits - 1) - 1)
+        for block in program.blocks:
+            kept = []
+            rewire = {}
+            for op in block.ops:
+                if op.type.startswith('fake_quantize_dequantize'):
+                    src = op.inputs['X'][0]
+                    dst = op.outputs['Out'][0]
+                    v = block._find_var_recursive(src)
+                    if isinstance(v, Parameter) and src in scope:
+                        w = np.asarray(scope.vars[src])
+                        scale = float(np.abs(w).max()) or 1e-8
+                        qdq = np.clip(np.round(w / scale * rmax),
+                                      -rmax, rmax) / rmax * scale
+                        scope.vars[src] = scope.vars[src] * 0 + qdq.astype(
+                            w.dtype)
+                    rewire[dst] = src
+                    continue
+                for slot, names in list(op.inputs.items()):
+                    op.inputs[slot] = [rewire.get(n, n) for n in names]
+                kept.append(op)
+            block.ops = kept
+        program._bump()
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Store quantizable weights as int8 + float scale in the scope
+        (deploy-size artifact; ops dequantize on read)."""
+        from ..core.executor import global_scope
+        import jax.numpy as jnp
+        scope = scope or global_scope()
+        rmax = float(2 ** (self.weight_bits - 1) - 1)
+        converted = {}
+        block = program.global_block()
+        for name, v in block.vars.items():
+            if isinstance(v, Parameter) and name in scope:
+                w = np.asarray(scope.vars[name])
+                scale = float(np.abs(w).max()) or 1e-8
+                q = np.clip(np.round(w / scale * rmax),
+                            -rmax, rmax).astype(np.int8)
+                converted[name] = (q, scale)
+        return converted

@@ -98,10 +98,27 @@ def _zero_cotangent(v):
     return np.zeros(v.shape, dtype=jax.dtypes.float0)
 
 
+# MXU-bound ops worth running in bfloat16 under AMP (matmul/conv class);
+# their inputs cast down, outputs cast back up, XLA fuses the casts into
+# the surrounding elementwise work
+_AMP_OPS = {'mul', 'matmul', 'conv2d', 'conv3d', 'conv2d_transpose',
+            'conv3d_transpose', 'flash_attention', 'ring_attention',
+            'sequence_conv', 'bilinear_tensor_product'}
+
+
+def _amp_cast(x, to):
+    import jax.numpy as jnp
+    if hasattr(x, 'dtype') and x.dtype == (
+            jnp.float32 if to == jnp.bfloat16 else jnp.bfloat16):
+        return x.astype(to)
+    return x
+
+
 def _exec_ops(ops, op_offset, env, ectx, program):
     """Trace a run of registered ops into `env` (the heart of lowering)."""
     import jax.lax as lax
     import jax.numpy as jnp
+    amp = getattr(program, '_amp', False)
     for i, op in enumerate(ops):
         if op.type in _CONTROL_FLOW:
             from . import control_flow_exec
@@ -109,12 +126,20 @@ def _exec_ops(ops, op_offset, env, ectx, program):
                 op, env, ectx, op_offset + i, program)
             continue
         impl = registry.get_op(op.type).impl
+        use_amp = amp and op.type in _AMP_OPS
         ins = {}
         for slot, names in op.inputs.items():
             vals = [env[n] for n in names]
+            if use_amp:
+                vals = [_amp_cast(v, jnp.bfloat16) for v in vals]
             ins[slot] = vals if op.input_is_list[slot] else vals[0]
         ctx = ectx.for_op(op_offset + i, op)
         outs = impl(ctx, ins, op.attrs)
+        if use_amp and outs:
+            outs = {s: ([_amp_cast(v, jnp.float32) for v in vs]
+                        if isinstance(vs, (list, tuple))
+                        else _amp_cast(vs, jnp.float32))
+                    for s, vs in outs.items()}
         if outs is None:
             outs = {}
         for slot, names in op.outputs.items():

@@ -593,9 +593,18 @@ class Program(object):
         self._is_test = False
         # sharding annotations attached by parallel/transpiler.py
         self._sharding = {}
+        # bf16 auto-mixed-precision for MXU ops (set_amp / contrib amp)
+        self._amp = False
 
     def _bump(self):
         self._version += 1
+
+    def set_amp(self, flag=True):
+        """Enable bf16 auto-mixed-precision: matmul-class ops run with
+        bfloat16 inputs (MXU native), everything else stays float32.  The
+        lowered executable re-jits on change."""
+        self._amp = bool(flag)
+        self._bump()
 
     def set_sharding(self, name, spec):
         """Attach a PartitionSpec to var `name`; bumps the version so the

@@ -395,3 +395,40 @@ def maxout(ctx, ins, attrs):
     g = attrs['groups']
     n, c, h, w = x.shape
     return {'Out': x.reshape(n, c // g, g, h, w).max(axis=2)}
+
+
+@register('fake_quantize_dequantize_abs_max')
+def fake_quantize_dequantize_abs_max(ctx, ins, attrs):
+    """QAT fake-quant: quantize to `bit_length` ints at abs-max scale and
+    dequantize back, with a straight-through gradient.
+
+    Parity: reference operators/fake_quantize_op (+contrib quantize
+    transpiler semantics).  On TPU the quant/dequant pair stays in the one
+    fused executable; the STE is `x + stop_grad(qdq(x) - x)`."""
+    x = ins['X']
+    bits = attrs.get('bit_length', 8)
+    rmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / safe * rmax), -rmax, rmax)
+    qdq = q / rmax * safe
+    out = x + lax.stop_gradient(qdq - x)
+    return {'Out': out, 'OutScale': scale.reshape(1)}
+
+
+@register('fake_quantize_dequantize_moving_average_abs_max')
+def fake_quantize_dequantize_moving_average_abs_max(ctx, ins, attrs):
+    """Activation fake-quant with a moving-average abs-max scale carried in
+    a persistable state var (parity: reference moving_average_abs_max)."""
+    x = ins['X']
+    state = ins['InScale'].reshape(())
+    bits = attrs.get('bit_length', 8)
+    rate = attrs.get('moving_rate', 0.9)
+    rmax = float(2 ** (bits - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    new_state = jnp.where(state > 0, rate * state + (1 - rate) * cur, cur)
+    safe = jnp.maximum(lax.stop_gradient(new_state), 1e-8)
+    q = jnp.clip(jnp.round(x / safe * rmax), -rmax, rmax)
+    qdq = q / rmax * safe
+    out = x + lax.stop_gradient(qdq - x)
+    return {'Out': out, 'OutScale': new_state.reshape(1)}

@@ -1,0 +1,104 @@
+"""QAT quantize transpiler tests."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import QuantizeTranspiler
+
+
+def _build(act_qtype='abs_max'):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        h = layers.fc(x, 16, act='relu',
+                      param_attr=fluid.ParamAttr(name='w1'))
+        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name='w2'))
+        loss = layers.reduce_mean(layers.square(pred - y))
+        t = QuantizeTranspiler(activation_quantize_type=act_qtype)
+        t.training_transpile(main, startup)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    return main, startup, pred, loss, t
+
+
+def test_qat_inserts_fake_quant_and_converges():
+    main, startup, pred, loss, t = _build()
+    types = [op.type for op in main.global_block().ops]
+    n_fq = sum(1 for t_ in types
+               if t_.startswith('fake_quantize_dequantize'))
+    assert n_fq == 4  # two muls x (weight + activation)
+    # fake-quanted training still converges
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(8, 1).astype('float32')
+    exe = fluid.Executor()
+    exe.run(startup)
+    losses = []
+    for _ in range(60):
+        xb = rng.rand(32, 8).astype('float32')
+        l, = exe.run(main, feed={'x': xb, 'y': xb @ w_true},
+                     fetch_list=[loss])
+        losses.append(float(np.asarray(l).reshape(())))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_qat_moving_average_scale_state():
+    main, startup, pred, loss, t = _build('moving_average_abs_max')
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            xb = rng.rand(16, 8).astype('float32')
+            exe.run(main, feed={'x': xb, 'y': xb.sum(1, keepdims=True)},
+                    fetch_list=[loss])
+        # the activation scale state was created persistable and updated
+        scales = [n for n in scope.vars if '.scale' in n]
+        assert scales, 'no activation scale states found'
+        assert all(float(np.asarray(scope.vars[n]).reshape(())) > 0
+                   for n in scales)
+
+
+def test_freeze_program_matches_qat_predictions():
+    main, startup, pred, loss, t = _build()
+    rng = np.random.RandomState(2)
+    w_true = rng.rand(8, 1).astype('float32')
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(40):
+            xb = rng.rand(32, 8).astype('float32')
+            exe.run(main, feed={'x': xb, 'y': xb @ w_true},
+                    fetch_list=[loss])
+        xt = rng.rand(8, 8).astype('float32')
+        qat_pred, = exe.run(main, feed={'x': xt, 'y': xt @ w_true},
+                            fetch_list=[pred])
+
+        infer = main.clone(for_test=True)
+        t.freeze_program(infer, scope=scope)
+        types = [op.type for op in infer.global_block().ops]
+        assert not any(ty.startswith('fake_quantize') for ty in types)
+        frozen_pred, = exe.run(infer, feed={'x': xt, 'y': xt @ w_true},
+                               fetch_list=[pred])
+    # frozen graph runs activations at float precision (activation
+    # fake-quants removed), so predictions differ by up to the 8-bit
+    # activation quantization step
+    assert np.allclose(qat_pred, frozen_pred, atol=5e-2), \
+        np.abs(np.asarray(qat_pred) - np.asarray(frozen_pred)).max()
+
+
+def test_convert_to_int8():
+    main, startup, pred, loss, t = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        packed = t.convert_to_int8(main, scope=scope)
+    assert 'w1' in packed and 'w2' in packed
+    q, scale = packed['w1']
+    assert q.dtype == np.int8 and scale > 0
+    # dequantized weights approximate the originals
+    w = np.asarray(scope.vars['w1'])
+    deq = q.astype('float32') / 127.0 * scale
+    assert np.abs(deq - w).max() <= scale / 127.0 + 1e-6
