@@ -72,6 +72,16 @@ from .async_executor import AsyncExecutor  # noqa
 from .data_feed_desc import DataFeedDesc  # noqa
 
 
+from .core.framework import recompute_scope  # noqa
+
+
+def recompute(fn, *args, **kwargs):
+    """jax.checkpoint for raw JAX callables (graph programs use
+    `recompute_scope()`); SURVEY §2.1 memory_optimize replacement."""
+    import jax
+    return jax.checkpoint(fn, *args, **kwargs)
+
+
 def memory_optimize_hint(*a, **k):
     return None
 

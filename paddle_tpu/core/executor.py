@@ -115,7 +115,46 @@ def _amp_cast(x, to):
 
 
 def _exec_ops(ops, op_offset, env, ectx, program):
-    """Trace a run of registered ops into `env` (the heart of lowering)."""
+    """Trace a run of registered ops into `env` (the heart of lowering).
+    Contiguous runs of ops sharing a recompute_id execute under
+    jax.checkpoint: their activations are rematerialized in the backward
+    pass instead of saved (see framework.recompute_scope)."""
+    import jax
+    i = 0
+    n = len(ops)
+    while i < n:
+        rid = ops[i].attrs.get('recompute_id')
+        if rid is None or ops[i].type in _CONTROL_FLOW:
+            _exec_ops_plain(ops[i:i + 1], op_offset + i, env, ectx, program)
+            i += 1
+            continue
+        j = i
+        while j < n and ops[j].attrs.get('recompute_id') == rid and \
+                ops[j].type not in _CONTROL_FLOW:
+            j += 1
+        group = ops[i:j]
+        reads = set()
+        writes = []
+        produced = set()
+        for op in group:
+            for nm in op.input_names():
+                if nm not in produced:
+                    reads.add(nm)
+            for nm in op.output_names():
+                produced.add(nm)
+                writes.append(nm)
+        ext_in = {nm: env[nm] for nm in reads if nm in env}
+
+        def grp_fn(ins, _group=group, _off=op_offset + i, _w=writes):
+            env2 = dict(ins)
+            _exec_ops_plain(_group, _off, env2, ectx, program)
+            return {nm: env2[nm] for nm in _w if nm in env2}
+
+        env.update(jax.checkpoint(grp_fn)(ext_in))
+        i = j
+
+
+def _exec_ops_plain(ops, op_offset, env, ectx, program):
     import jax.lax as lax
     import jax.numpy as jnp
     amp = getattr(program, '_amp', False)

@@ -121,6 +121,27 @@ def op_role_guard(role):
         _current_role.pop()
 
 
+# recompute (rematerialization) scopes: ops appended inside carry a
+# recompute_id attr; the executor wraps each contiguous tagged run in
+# jax.checkpoint, trading recompute FLOPs for activation memory
+_recompute_stack = []
+_recompute_counter = [0]
+
+
+@contextlib.contextmanager
+def recompute_scope(name=None):
+    """Mark ops built inside for rematerialization (TPU-native replacement
+    for the reference's memory_optimize transpiler, SURVEY §2.1): their
+    activations are not saved for backward — they recompute in the vjp."""
+    _recompute_counter[0] += 1
+    rid = name or 'remat_%d' % _recompute_counter[0]
+    _recompute_stack.append(rid)
+    try:
+        yield
+    finally:
+        _recompute_stack.pop()
+
+
 _name_scope_stack = ['']
 
 
@@ -364,6 +385,8 @@ class Operator(object):
         self.type = type
         self.attrs = dict(attrs or {})
         self.attrs.setdefault('op_role', _current_role[-1])
+        if _recompute_stack:
+            self.attrs.setdefault('recompute_id', _recompute_stack[-1])
         self.inputs = {}        # slot -> list[str]
         self.outputs = {}       # slot -> list[str]
         self.input_is_list = {}

@@ -1,0 +1,67 @@
+"""recompute_scope (rematerialization) tests."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build(remat):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        h = x
+        if remat:
+            with fluid.recompute_scope():
+                for i in range(3):
+                    h = layers.fc(h, 16, act='relu',
+                                  param_attr=fluid.ParamAttr(name='w%d' % i),
+                                  bias_attr=False)
+        else:
+            for i in range(3):
+                h = layers.fc(h, 16, act='relu',
+                              param_attr=fluid.ParamAttr(name='w%d' % i),
+                              bias_attr=False)
+        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name='wout'),
+                         bias_attr=False)
+        loss = layers.reduce_mean(layers.square(pred - y))
+        fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_recompute_ops_tagged():
+    main, startup, loss = _build(True)
+    tagged = [op for op in main.global_block().ops
+              if 'recompute_id' in op.attrs]
+    assert len(tagged) >= 3  # the three fc mat muls (+activations)
+    ids = {op.attrs['recompute_id'] for op in tagged}
+    assert len(ids) == 1
+
+
+def test_recompute_matches_plain_numerics():
+    rng = np.random.RandomState(0)
+    xb = rng.rand(16, 8).astype('float32')
+    yb = xb.sum(1, keepdims=True)
+    res = {}
+    for remat in (False, True):
+        main, startup, loss = _build(remat)
+        main.random_seed = 7
+        scope = fluid.Scope()
+        exe = fluid.Executor()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ls = []
+            for _ in range(5):
+                l, = exe.run(main, feed={'x': xb, 'y': yb},
+                             fetch_list=[loss])
+                ls.append(float(np.asarray(l).reshape(())))
+        res[remat] = ls
+    assert np.allclose(res[False], res[True], rtol=1e-5), res
+    assert res[True][-1] < res[True][0]  # and it actually trains
+
+
+def test_recompute_fn_wrapper():
+    import jax.numpy as jnp
+    f = fluid.recompute(lambda x: jnp.sin(x) ** 2)
+    assert np.allclose(np.asarray(f(jnp.float32(0.5))),
+                       np.sin(0.5) ** 2, atol=1e-6)
