@@ -13,3 +13,6 @@ from .ring_attention import ring_attention, ring_attention_sharded  # noqa
 from .pipeline import pipeline_apply, stack_stage_params  # noqa
 from .sharded_embedding import shard_embedding, sharded_embedding  # noqa
 from . import moe  # noqa
+from . import distributed  # noqa
+from .distributed import (init_parallel_env, get_rank,  # noqa
+                          get_world_size, barrier, global_mesh)

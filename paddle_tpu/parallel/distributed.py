@@ -1,0 +1,99 @@
+"""Multi-host runtime: jax.distributed bootstrap + rank helpers.
+
+Parity: the reference's NCCL2/gRPC trainer bootstrap (transpiler nccl2
+mode + paddle/fluid/platform/nccl_helper.h, PADDLE_TRAINER_* env
+convention).  TPU-native: every host runs the SAME SPMD program; this
+module only brings up the JAX distributed runtime (coordination service +
+cross-host device visibility) and exposes rank/size.  Collectives
+themselves are XLA ops (psum/ppermute/...) emitted by GSPMD from sharding
+annotations — there is no NCCL communicator object to manage.
+
+Env convention (reference-compatible):
+  PADDLE_TRAINER_ID        -> process_id
+  PADDLE_TRAINERS_NUM      -> num_processes
+  PADDLE_TRAINER_ENDPOINTS -> comma list; first entry = coordinator
+  PADDLE_CURRENT_ENDPOINT  -> this host (used to infer id if unset)
+"""
+import os
+
+__all__ = ['init_parallel_env', 'get_rank', 'get_world_size', 'barrier',
+           'global_mesh', 'is_initialized']
+
+_state = {'initialized': False, 'rank': 0, 'world': 1}
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return default
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Bring up jax.distributed across hosts.  No-op (returns rank 0/world
+    1) when neither args nor PADDLE_TRAINER_* / JAX envs describe a
+    multi-process job."""
+    import jax
+
+    eps = _env('PADDLE_TRAINER_ENDPOINTS')
+    if coordinator_address is None and eps:
+        coordinator_address = eps.replace('\n', ',').split(',')[0]
+    if num_processes is None:
+        n = _env('PADDLE_TRAINERS_NUM')
+        if n:
+            num_processes = int(n)
+        elif eps:
+            num_processes = len([e for e in eps.split(',') if e])
+    if process_id is None:
+        tid = _env('PADDLE_TRAINER_ID')
+        if tid is not None:
+            process_id = int(tid)
+        elif eps and _env('PADDLE_CURRENT_ENDPOINT'):
+            ep_list = [e for e in eps.replace('\n', ',').split(',') if e]
+            cur = _env('PADDLE_CURRENT_ENDPOINT')
+            process_id = ep_list.index(cur) if cur in ep_list else 0
+
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        _state.update(initialized=True, rank=0, world=1)
+        return 0, 1
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id)
+    _state.update(initialized=True, rank=jax.process_index(),
+                  world=jax.process_count())
+    return _state['rank'], _state['world']
+
+
+def is_initialized():
+    return _state['initialized']
+
+
+def get_rank():
+    import jax
+    return jax.process_index() if _state['initialized'] else 0
+
+
+def get_world_size():
+    import jax
+    return jax.process_count() if _state['initialized'] else 1
+
+
+def global_mesh(model=1, pipe=1, seq=1):
+    """Mesh over ALL processes' devices (jax.devices() is global after
+    init): data axis absorbs whatever the other axes don't."""
+    from .mesh import make_mesh
+    return make_mesh(model=model, pipe=pipe, seq=seq)
+
+
+def barrier(name='barrier'):
+    """Block until every process arrives (psum of 1 over all devices)."""
+    import jax
+    import jax.numpy as jnp
+    if get_world_size() <= 1:
+        return
+    out = jax.pmap(lambda x: jax.lax.psum(x, 'i'), axis_name='i')(
+        jnp.ones((jax.local_device_count(),)))
+    out.block_until_ready()
