@@ -2,32 +2,151 @@
 
 Mirrors the reference's benchmark/fluid/fluid_benchmark.py harness
 (--model machine_translation reports words/sec); here the whole train step
-(fwd + vjp bwd + Adam) is ONE XLA executable.  Prints one JSON line.
+(fwd + vjp bwd + Adam) is ONE XLA executable, run in bf16 AMP with the
+fused flash-attention kernel.
+
+Robustness (round-2): the TPU ('axon') backend is probed in a SUBPROCESS
+with a hard timeout before any in-process device work — a hung PJRT init
+cannot hang the benchmark.  On probe failure the bench falls back to CPU,
+prints loud diagnostics to stderr, and records the fallback in the JSON.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tok/s, "unit": "tokens/s", "vs_baseline": ...,
+   "mfu": model-flops-utilization vs chip peak, "backend": ..., ...}
 
 vs_baseline denominator: ~5100 tokens/s/GPU, the Fluid-era V100 fp32
 transformer-base figure recorded in SURVEY.md §5 (BASELINE.json has no
 published numbers).
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 BASELINE_TOKENS_PER_SEC = 5100.0
+PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_TIMEOUT', '300'))
+
+# peak bf16 FLOP/s by TPU generation (public spec sheets)
+_PEAK_BF16 = {
+    'v4': 275e12,
+    'v5 lite': 197e12, 'v5e': 197e12, 'v5litepod': 197e12,
+    'v5p': 459e12, 'v5': 459e12,
+    'v6e': 918e12, 'v6 lite': 918e12, 'trillium': 918e12,
+}
+
+_PROBE_CODE = r"""
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((128, 128), jnp.bfloat16)
+s = float((x @ x).sum())
+assert s == 128 * 128 * 128, s
+print('PROBE_OK', d[0].platform, '|', d[0].device_kind)
+"""
+
+
+def probe_backend():
+    """Run a trivial device computation in a subprocess with a timeout.
+    Returns (platform, device_kind) or (None, reason)."""
+    try:
+        r = subprocess.run([sys.executable, '-c', _PROBE_CODE],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None, 'probe timed out after %ds (PJRT init hang)' % \
+            PROBE_TIMEOUT_S
+    for line in r.stdout.splitlines():
+        if line.startswith('PROBE_OK'):
+            _, platform, _, kind = line.split(None, 3)
+            return platform, kind
+    tail = (r.stderr or r.stdout).strip().splitlines()[-8:]
+    return None, 'probe rc=%d: %s' % (r.returncode, ' | '.join(tail))
+
+
+def peak_flops(device_kind):
+    kind = (device_kind or '').lower()
+    for key, val in sorted(_PEAK_BF16.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return None
+
+
+def allreduce_bw_gbps(n_iters=10, nbytes=64 * 1024 * 1024):
+    """psum bandwidth across local devices (BASELINE.json headline metric).
+    Only meaningful with >1 device; returns None single-chip."""
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    mesh = Mesh(np.array(devs), ('x',))
+    n = nbytes // 4 // len(devs) * len(devs)
+    x = jnp.ones((n,), jnp.float32)
+
+    @jax.jit
+    def ar(v):
+        return shard_map(lambda s: jax.lax.psum(s, 'x'),
+                         mesh=mesh, in_specs=P('x'), out_specs=P(None))(v)
+
+    ar(x).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = ar(x)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    # ring allreduce moves 2*(n-1)/n of the buffer per device
+    moved = 2 * (len(devs) - 1) / len(devs) * n * 4 * n_iters
+    return moved / dt / 1e9
 
 
 def main():
+    platform, kind_or_reason = probe_backend()
+    fallback_reason = None
+    if platform is None:
+        fallback_reason = kind_or_reason
+        print('BENCH: TPU backend probe FAILED — %s' % fallback_reason,
+              file=sys.stderr)
+        print('BENCH: falling back to CPU so a number still lands',
+              file=sys.stderr)
+        device_kind = 'cpu-fallback'
+    else:
+        device_kind = kind_or_reason
+        print('BENCH: backend ok: %s (%s)' % (platform, device_kind),
+              file=sys.stderr)
+
+    import jax
+    if platform is None:
+        jax.config.update('jax_platforms', 'cpu')
+
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as tr
 
-    B, T, vocab = 64, 64, 32000
+    on_tpu = platform not in (None, 'cpu')
+    # transformer-base; dropout off so training uses the fused flash kernel
+    B = 32 if on_tpu else 4
+    T = 256 if on_tpu else 64
+    vocab = 32000
+    n_layer, n_head, d_model, d_inner = 6, 8, 512, 2048
+
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         with fluid.unique_name.guard():
             out = tr.build(src_vocab=vocab, trg_vocab=vocab, max_len=T,
-                           n_layer=6, n_head=8, d_model=512, d_inner=2048,
-                           dropout=0.1, use_flash=False)
+                           n_layer=n_layer, n_head=n_head, d_model=d_model,
+                           d_inner=d_inner, dropout=0.0, use_flash=True)
+    main_prog.set_amp(True)
+
+    # tiny-shape warmup first: a failure or hang surfaces on a 2s compile,
+    # not after the full-size 30s one
+    t0 = time.perf_counter()
+    print('BENCH: tiny warmup compile...', file=sys.stderr)
+    _tiny_warmup(fluid, vocab)
+    print('BENCH: tiny warmup ok (%.1fs)' % (time.perf_counter() - t0),
+          file=sys.stderr)
 
     exe = fluid.Executor()
     scope = fluid.Scope()
@@ -40,11 +159,21 @@ def main():
     feed = tr.make_batch(rows, T)
     tokens_per_step = float(np.sum(1.0 - feed['trg_pad']))
 
+    n_params = sum(
+        int(np.prod(v.shape)) for v in
+        main_prog.global_block().all_parameters() if v.shape)
+
     with fluid.scope_guard(scope):
+        t0 = time.perf_counter()
         exe.run(startup)
+        print('BENCH: startup ok (%.1fs)' % (time.perf_counter() - t0),
+              file=sys.stderr)
+        t0 = time.perf_counter()
         for _ in range(3):  # compile + warmup
             exe.run(main_prog, feed=feed, fetch_list=[out['loss']])
-        steps = 30
+        print('BENCH: train-step compile+warmup ok (%.1fs)'
+              % (time.perf_counter() - t0), file=sys.stderr)
+        steps = 30 if on_tpu else 10
         t0 = time.perf_counter()
         for _ in range(steps):
             loss, = exe.run(main_prog, feed=feed,
@@ -53,12 +182,58 @@ def main():
         dt = time.perf_counter() - t0
 
     tps = steps * tokens_per_step / dt
-    print(json.dumps({
+
+    # model FLOPs (scaling-book accounting): 6*P per trained token for the
+    # dense stack, + 12*T*d per token per attention layer for the score /
+    # context matmuls (fwd 4*T*d, bwd x2); enc self + dec self + dec cross
+    attn_layers = 3 * n_layer
+    flops_per_token = 6.0 * n_params + 12.0 * T * d_model * attn_layers
+    model_flops_per_s = flops_per_token * tps
+    peak = peak_flops(device_kind) if on_tpu else None
+    mfu = round(model_flops_per_s / peak, 4) if peak else None
+
+    ar_bw = None
+    try:
+        ar_bw = allreduce_bw_gbps()
+    except Exception as e:  # noqa: BLE001 - diagnostic-only path
+        print('BENCH: allreduce microbench failed: %s' % e, file=sys.stderr)
+
+    rec = {
         'metric': 'transformer_base_tokens_per_sec_per_chip',
         'value': round(tps, 1),
         'unit': 'tokens/s',
         'vs_baseline': round(tps / BASELINE_TOKENS_PER_SEC, 3),
-    }))
+        'mfu': mfu,
+        'model_tflops_per_s': round(model_flops_per_s / 1e12, 2),
+        'params_m': round(n_params / 1e6, 1),
+        'backend': device_kind,
+        'batch': B, 'seq': T, 'amp': True, 'flash': True,
+    }
+    if fallback_reason:
+        rec['fallback'] = fallback_reason
+    if ar_bw is not None:
+        rec['allreduce_gbps'] = round(ar_bw, 1)
+    print(json.dumps(rec))
+
+
+def _tiny_warmup(fluid, vocab):
+    """One 2-layer micro train step end-to-end: exercises the same lowering
+    path at trivial size so backend trouble shows up fast."""
+    from paddle_tpu.models import transformer as tr
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            out = tr.build(src_vocab=128, trg_vocab=128, max_len=8,
+                           n_layer=1, n_head=2, d_model=32, d_inner=64,
+                           dropout=0.0, use_flash=False)
+    prog.set_amp(True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rows = [(np.array([3, 4, 1]), np.array([0, 3, 4]), np.array([3, 4, 1]))]
+    feed = tr.make_batch(rows, 8)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(prog, feed=feed, fetch_list=[out['loss']])
 
 
 if __name__ == '__main__':

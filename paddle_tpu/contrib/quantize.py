@@ -108,10 +108,14 @@ class QuantizeTranspiler(object):
     # ----------------------------------------------------------- freeze
     def freeze_program(self, program, place=None, fuse_bn=False,
                        scope=None):
-        """Turn a QAT program into an inference program: activation
-        fake-quants become no-ops (scales are baked into the weights),
-        weight fake-quants are folded by re-quantizing the trained weights
-        once on the host."""
+        """Turn a QAT program into an inference program.  Weight
+        fake-quants are folded by re-quantizing the trained weights once
+        on the host; activation fake-quants are REPLACED by fixed-scale
+        quantize/dequantize ops using the trained moving-average scale
+        (parity: the reference freeze pass keeps quantize/dequantize with
+        recorded scales), so frozen numerics match what QAT simulated.
+        Activation quants with no recorded scale (abs_max mode) are kept
+        as-is — their scale is computed per batch at inference too."""
         from ..core.executor import global_scope
         scope = scope or global_scope()
         rmax = float(2 ** (self.weight_bits - 1) - 1)
@@ -119,21 +123,35 @@ class QuantizeTranspiler(object):
             kept = []
             rewire = {}
             for op in block.ops:
+                for slot, names in list(op.inputs.items()):
+                    op.inputs[slot] = [rewire.get(n, n) for n in names]
                 if op.type.startswith('fake_quantize_dequantize'):
                     src = op.inputs['X'][0]
                     dst = op.outputs['Out'][0]
                     v = block._find_var_recursive(src)
                     if isinstance(v, Parameter) and src in scope:
+                        # weight: fold the qdq into the stored tensor
                         w = np.asarray(scope.vars[src])
                         scale = float(np.abs(w).max()) or 1e-8
                         qdq = np.clip(np.round(w / scale * rmax),
                                       -rmax, rmax) / rmax * scale
                         scope.vars[src] = scope.vars[src] * 0 + qdq.astype(
                             w.dtype)
-                    rewire[dst] = src
-                    continue
-                for slot, names in list(op.inputs.items()):
-                    op.inputs[slot] = [rewire.get(n, n) for n in names]
+                        rewire[dst] = src
+                        continue
+                    in_scale = op.inputs.get('InScale', [None])[0]
+                    trained = (float(np.asarray(scope.vars[in_scale]).sum())
+                               if in_scale and in_scale in scope else 0.0)
+                    if trained > 0:
+                        # activation: freeze at the trained moving-average
+                        # scale
+                        op = Operator(
+                            block, 'quantize_dequantize_fixed_scale',
+                            inputs={'X': op.inputs['X'][0]},
+                            outputs={'Out': dst},
+                            attrs={'scale': trained,
+                                   'bit_length':
+                                       op.attrs.get('bit_length', 8)})
                 kept.append(op)
             block.ops = kept
         program._bump()

@@ -93,6 +93,12 @@ class Trainer(object):
         self.__stop = True
 
     def _feeder(self, feed_order, program):
+        if feed_order is None:
+            # reference contrib Trainer derives the feed list from the
+            # program's data vars when feed_order is omitted
+            block = program.global_block()
+            feed_order = [n for n, v in block.vars.items()
+                          if v.is_data and not n.endswith('@LENGTH')]
         feed_vars = [program.global_block().var(n) for n in feed_order]
         return DataFeeder(feed_vars, program=program)
 

@@ -1,0 +1,298 @@
+"""Graph-mode control flow lowering: While / conditional_block / tensor arrays.
+
+Parity: reference paddle/fluid/operators/while_op.cc,
+conditional_block_op.cc, lod_tensor_array ops
+(python/paddle/fluid/layers/control_flow.py:504 `class While`).
+
+TPU-native design.  The reference interprets a while op by re-running the
+sub-block's op list on the CPU each iteration, with LoDTensorArrays as
+growable vector<LoDTensor>.  Under whole-block XLA lowering the loop must be
+a structured HLO loop:
+
+* `while` lowers to a **masked `lax.scan`** when the trip-count upper bound
+  is statically derivable from the condition chain (``less_than(i, n)`` with
+  ``n`` a build-time constant): every iteration runs, and a carried
+  ``active`` flag select-masks the writes.  This form is
+  reverse-differentiable (training RNN-style loops works) and gives XLA a
+  static trip count to schedule.
+* Otherwise it lowers to `lax.while_loop` (forward-only: XLA/JAX cannot
+  reverse-differentiate an unbounded loop).
+* `conditional_block` lowers to `lax.cond` over the carried writes.
+
+Loop **carries** are the vars written anywhere in the sub-block (including
+nested sub-blocks) that already exist in the enclosing environment — the
+same def-use rule the reference's while_op uses to decide which parent-scope
+vars the body mutates.
+
+Tensor arrays are carried as a ``TensorArrayVal`` pytree: a fixed-capacity
+stacked buffer plus a dynamic length.  Capacity = the loop bound (or the
+explicit ``create_array(capacity=)``).  Element shape/dtype are discovered
+by a **speculative body trace** on the pre-loop values; the speculative
+outputs are discarded, so XLA dead-code-eliminates the extra trace and only
+the zero-initialised buffer survives.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# ops this module executes natively (no registry impl, no shape inference)
+NATIVE_OPS = {'while', 'conditional_block', 'write_to_array',
+              'read_from_array', 'array_length'}
+
+# while loops with a static bound at or under this lower to a masked scan
+# (differentiable); larger/unknown bounds use lax.while_loop (forward-only)
+_SCAN_BOUND_LIMIT = 16384
+
+
+@jax.tree_util.register_pytree_node_class
+class TensorArrayVal(object):
+    """Runtime tensor array: fixed-capacity buffer [cap, *elem] + length."""
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    def tree_flatten(self):
+        return (self.buffer, self.length), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+class _Unallocated(object):
+    """Placeholder for an array before its first write fixes elem shape."""
+
+    def __init__(self, capacity):
+        self.capacity = capacity
+
+
+# capacity hint pushed by the enclosing While lowering (loop bound)
+_cap_hint = [None]
+
+
+def _scalar_index(i):
+    i = jnp.asarray(i)
+    if i.ndim > 0:
+        i = i.reshape(-1)[0]
+    return i.astype(jnp.int32)
+
+
+def _written_names(block, program):
+    """All var names written by the block's ops, descending into nested
+    sub-blocks (their writes to outer vars are still writes)."""
+    names = []
+    for op in block.ops:
+        names.extend(op.output_names())
+        sb = op.attrs.get('sub_block')
+        if sb is not None:
+            names.extend(_written_names(program.block(sb), program))
+    # preserve order, drop dups
+    seen = set()
+    out = []
+    for n in names:
+        if n not in seen:
+            seen.add(n)
+            out.append(n)
+    return out
+
+
+def _static_bound(cond_name, block):
+    """Derive a static trip-count upper bound from the condition producer:
+    ``less_than(i, n)`` where n is a build-time fill_constant."""
+    cvar = block._find_var_recursive(cond_name)
+    if cvar is None or cvar.op is None:
+        return None
+    op = cvar.op
+    if op.type != 'less_than':
+        return None
+    yvar = block._find_var_recursive(op.inputs['Y'][0])
+    if yvar is None or yvar.op is None or yvar.op.type != 'fill_constant':
+        return None
+    try:
+        return int(yvar.op.attrs['value'])
+    except (TypeError, ValueError):
+        return None
+
+
+def _run_block(sub, env, ectx, program):
+    from . import executor as _exec
+    _exec._exec_ops(sub.ops, sub.idx * 4096, env, ectx, program)
+
+
+def _coerce_carry(new, old, name):
+    """Carried var after one body pass must keep its aval: cast dtype back
+    (paddle vars have a fixed dtype; jnp promotion inside the body must not
+    leak), and hard-error on shape drift."""
+    if isinstance(old, TensorArrayVal) or isinstance(new, TensorArrayVal):
+        return new
+    new = jnp.asarray(new)
+    old = jnp.asarray(old)
+    if new.shape != old.shape:
+        raise ValueError(
+            'while-loop carry "%s" changed shape %s -> %s inside the body; '
+            'loop-carried vars must keep a fixed shape under XLA'
+            % (name, old.shape, new.shape))
+    if new.dtype != old.dtype:
+        new = new.astype(old.dtype)
+    return new
+
+
+def _prealloc_arrays(sub, env, ectx, program, carry_names, bound):
+    """Speculatively trace the body once on the pre-loop env to discover the
+    element shape of any tensor array first written inside the loop, then
+    replace it in `env` with a zeroed buffer.  The speculative values are
+    discarded -> XLA DCE removes the duplicate trace."""
+    arr_names = [n for n in carry_names
+                 if isinstance(env.get(n), (_Unallocated, type(None)))
+                 and _is_array_var(sub, n)]
+    if not arr_names:
+        return
+    spec_env = dict(env)
+    old_hint = _cap_hint[0]
+    _cap_hint[0] = bound
+    try:
+        _run_block(sub, spec_env, ectx, program)
+    finally:
+        _cap_hint[0] = old_hint
+    for n in arr_names:
+        v = spec_env.get(n)
+        if not isinstance(v, TensorArrayVal):
+            raise ValueError(
+                'tensor array "%s" is carried by a while loop but the body '
+                'never writes it with a resolvable element shape' % n)
+        env[n] = TensorArrayVal(jnp.zeros_like(v.buffer),
+                                jnp.asarray(0, jnp.int32))
+
+
+def _is_array_var(block, name):
+    v = block._find_var_recursive(name)
+    return v is not None and getattr(v, 'is_tensor_array', False)
+
+
+def exec_control_flow_op(op, env, ectx, op_index, program):
+    if op.type == 'while':
+        _exec_while(op, env, ectx, program)
+    elif op.type == 'conditional_block':
+        _exec_cond_block(op, env, ectx, program)
+    elif op.type == 'write_to_array':
+        _exec_array_write(op, env)
+    elif op.type == 'read_from_array':
+        _exec_array_read(op, env)
+    elif op.type == 'array_length':
+        arr = _get_array(env, op.inputs['A'][0])
+        env[op.outputs['Out'][0]] = arr.length.reshape((1,)).astype(jnp.int64)
+    else:
+        raise KeyError('unknown native control-flow op %s' % op.type)
+
+
+# --------------------------------------------------------------- arrays
+
+def _get_array(env, name):
+    v = env.get(name)
+    if not isinstance(v, TensorArrayVal):
+        raise ValueError(
+            'tensor array "%s" read before any write; initialize it with '
+            'array_write first' % name)
+    return v
+
+
+def _exec_array_write(op, env):
+    name = op.outputs['Out'][0]
+    x = jnp.asarray(env[op.inputs['X'][0]])
+    i = _scalar_index(env[op.inputs['I'][0]])
+    cur = env.get(name)
+    if not isinstance(cur, TensorArrayVal):
+        cap = cur.capacity if isinstance(cur, _Unallocated) else None
+        cap = cap or _cap_hint[0] or op.attrs.get('capacity')
+        if cap is None:
+            raise ValueError(
+                'cannot size tensor array "%s": no static loop bound was '
+                'derivable and no explicit capacity given — use '
+                'create_array(dtype, capacity=N)' % name)
+        cur = TensorArrayVal(jnp.zeros((int(cap),) + x.shape, x.dtype),
+                             jnp.asarray(0, jnp.int32))
+    buf = lax.dynamic_update_index_in_dim(cur.buffer, x.astype(
+        cur.buffer.dtype), i, 0)
+    length = jnp.maximum(cur.length, i + 1)
+    env[name] = TensorArrayVal(buf, length)
+
+
+def _exec_array_read(op, env):
+    arr = _get_array(env, op.inputs['A'][0])
+    i = _scalar_index(env[op.inputs['I'][0]])
+    env[op.outputs['Out'][0]] = lax.dynamic_index_in_dim(
+        arr.buffer, i, 0, keepdims=False)
+
+
+# ---------------------------------------------------------------- while
+
+def _exec_while(op, env, ectx, program):
+    sub = program.block(op.attrs['sub_block'])
+    cond_name = op.inputs['Condition'][0]
+    written = _written_names(sub, program)
+    if cond_name not in written:
+        raise ValueError(
+            'While body never updates its condition var "%s" — the loop '
+            'would not terminate. Update it with layers.less_than(..., '
+            'cond=cond) or layers.assign.' % cond_name)
+    bound = _static_bound(cond_name, sub)
+
+    # tensor arrays written in the body need a pre-sized buffer carry
+    carry_names = [n for n in written if n in env or _is_array_var(sub, n)]
+    _prealloc_arrays(sub, env, ectx, program, carry_names, bound)
+    carry_names = [n for n in carry_names if n in env]
+    if cond_name not in carry_names:
+        carry_names.append(cond_name)
+
+    init = {n: env[n] for n in carry_names}
+
+    def body(carry):
+        env2 = dict(env)
+        env2.update(carry)
+        _run_block(sub, env2, ectx, program)
+        return {n: _coerce_carry(env2[n], carry[n], n) for n in carry_names}
+
+    def cond_of(carry):
+        c = jnp.asarray(carry[cond_name])
+        return jnp.all(c) if c.ndim else c
+
+    if bound is not None and bound <= _SCAN_BOUND_LIMIT:
+        # masked scan: fixed trip count, reverse-differentiable
+        def step(carry, _):
+            active = cond_of(carry)
+            new = body(carry)
+            merged = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(active, a, b), new, carry)
+            return merged, None
+        final, _ = lax.scan(step, init, None, length=int(bound))
+    else:
+        final = lax.while_loop(cond_of, body, init)
+    env.update(final)
+
+
+# --------------------------------------------------------- conditional
+
+def _exec_cond_block(op, env, ectx, program):
+    sub = program.block(op.attrs['sub_block'])
+    cond = jnp.asarray(env[op.inputs['Condition'][0]])
+    pred = jnp.all(cond) if cond.ndim else cond
+
+    written = _written_names(sub, program)
+    carry_names = [n for n in written if n in env or _is_array_var(sub, n)]
+    _prealloc_arrays(sub, env, ectx, program, carry_names, None)
+    carry_names = [n for n in carry_names if n in env]
+    operand = {n: env[n] for n in carry_names}
+
+    def true_fn(carry):
+        env2 = dict(env)
+        env2.update(carry)
+        _run_block(sub, env2, ectx, program)
+        return {n: _coerce_carry(env2[n], carry[n], n) for n in carry_names}
+
+    def false_fn(carry):
+        return carry
+
+    env.update(lax.cond(pred, true_fn, false_fn, operand))

@@ -18,7 +18,11 @@ __all__ = ['Executor', 'Scope', 'scope_guard', 'global_scope']
 
 # ops the executor handles natively (no registry impl)
 _BACKWARD_OP = '__backward__'
-_CONTROL_FLOW = {'while', 'conditional_block'}
+from .control_flow_exec import NATIVE_OPS as _CONTROL_FLOW
+
+import itertools
+
+_scope_serial = itertools.count()
 
 
 class Scope(object):
@@ -26,10 +30,13 @@ class Scope(object):
 
     Parity: paddle/fluid/framework/scope.{h,cc}.  Flat (the reference's
     scope hierarchy existed for per-thread local scopes in the parallel
-    executor; with a single XLA executable temporaries never materialize)."""
+    executor; with a single XLA executable temporaries never materialize).
+    `_serial` is a process-unique id used in the executor's lowering-cache
+    key — unlike id(), it can never be recycled by a later Scope."""
 
     def __init__(self):
         self.vars = {}
+        self._serial = next(_scope_serial)
 
     def var(self, name):
         return self
@@ -199,9 +206,13 @@ def _exec_ops_plain(ops, op_offset, env, ectx, program):
 
 def _analyze(block, feed_names, fetch_names):
     """Static analysis: which persistables must come from scope, which get
-    written back."""
-    persistable = {n for n, v in block.vars.items() if v.persistable}
-    # include parent blocks (sub-block analysis sees root vars)
+    written back.  Recurses into control-flow sub-blocks: a persistable
+    referenced anywhere inside a while/conditional body (even write-only —
+    it's a loop carry needing an initial value) counts as required."""
+    program = block.program
+    persistable = set()
+    for b in program.blocks:
+        persistable |= {n for n, v in b.vars.items() if v.persistable}
     written = set()
     required = set()
     feed = set(feed_names)
@@ -210,15 +221,23 @@ def _analyze(block, feed_names, fetch_names):
         if n in persistable and n not in written and n not in feed:
             required.add(n)
 
-    for op in block.ops:
-        for n in op.input_names():
-            visit_read(n)
-        if op.type == _BACKWARD_OP:
-            for p in op.attrs['params']:
-                visit_read(p)
-        for n in op.output_names():
-            if n in persistable:
-                written.add(n)
+    def visit_block(b, is_sub):
+        for op in b.ops:
+            for n in op.input_names():
+                visit_read(n)
+            if op.type == _BACKWARD_OP:
+                for p in op.attrs['params']:
+                    visit_read(p)
+            sb = op.attrs.get('sub_block')
+            if sb is not None:
+                visit_block(program.block(sb), True)
+            for n in op.output_names():
+                if is_sub:
+                    visit_read(n)
+                if n in persistable:
+                    written.add(n)
+
+    visit_block(block, False)
     for n in fetch_names:
         visit_read(n)
     return required, written
@@ -372,7 +391,7 @@ class Executor(object):
         fetch_names = tuple(self._resolve_fetch(fetch_list))
 
         key = (id(program), program._version, feed_names, fetch_names,
-               id(scope))
+               scope._serial)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             # the cached tuple keeps a strong ref to `program` so its id()

@@ -245,8 +245,14 @@ class Variable(object):
     __repr__ = __str__ = lambda self: self.to_string()
 
     # -------- math op patch (reference layers/math_op_patch.py) --------
+    def _cur_block(self):
+        # ops emit into the program's CURRENT block, not the var's home
+        # block — an expression on a root var inside a While body must
+        # land in the loop body, or it reads the pre-loop value forever
+        return self.block.program.current_block()
+
     def _binary(self, other, op_type, reverse=False):
-        block = self.block
+        block = self._cur_block()
         if isinstance(other, Variable):
             x, y = (other, self) if reverse else (self, other)
             out = block.create_var(dtype=self._dtype)
@@ -277,8 +283,9 @@ class Variable(object):
         return out
 
     def _scale(self, scale, bias):
-        out = self.block.create_var(dtype=self._dtype)
-        self.block.append_op(type='scale', inputs={'X': self},
+        blk = self._cur_block()
+        out = blk.create_var(dtype=self._dtype)
+        blk.append_op(type='scale', inputs={'X': self},
                             outputs={'Out': out},
                             attrs={'scale': float(scale), 'bias': float(bias),
                                    'bias_after_scale': True})
@@ -320,9 +327,10 @@ class Variable(object):
         return self._scale(-1.0, 0.0)
 
     def _cmp(self, other, op_type):
-        out = self.block.create_var(dtype='bool')
+        blk = self._cur_block()
+        out = blk.create_var(dtype='bool')
         other = other if isinstance(other, Variable) else _const_like(self, other)
-        self.block.append_op(type=op_type, inputs={'X': self, 'Y': other},
+        blk.append_op(type=op_type, inputs={'X': self, 'Y': other},
                             outputs={'Out': out}, attrs={})
         return out
 
@@ -339,8 +347,9 @@ class Variable(object):
         return self._cmp(o, 'greater_equal')
 
     def astype(self, dtype):
-        out = self.block.create_var(dtype=dtype)
-        self.block.append_op(type='cast', inputs={'X': self},
+        blk = self._cur_block()
+        out = blk.create_var(dtype=dtype)
+        blk.append_op(type='cast', inputs={'X': self},
                             outputs={'Out': out},
                             attrs={'in_dtype': self._dtype,
                                    'out_dtype': dtype_str(dtype)})

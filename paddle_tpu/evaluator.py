@@ -137,9 +137,11 @@ class DetectionMAP(Evaluator):
     The reference op threads pos_count/true_pos/false_pos state through
     every batch and recomputes AP over the union; here each batch's mAP
     comes from the stateless layers.detection_map and the accumulated
-    value is the detection-count-weighted running mean — equal when
-    per-batch score distributions are comparable, and documented as the
-    TPU-native simplification (no ragged cross-batch state tensors)."""
+    value is a **detection-count-weighted** running mean (weight =
+    `detect_count` when supplied, else 1 per batch) — equal to the global
+    mAP when per-batch score distributions are comparable, and documented
+    as the TPU-native simplification (no ragged cross-batch state
+    tensors)."""
 
     def __init__(self, input, gt_label, gt_box, gt_difficult=None,
                  class_num=None, background_label=0, overlap_threshold=0.5,
@@ -161,16 +163,24 @@ class DetectionMAP(Evaluator):
             detect_count=detect_count, label_count=label_count)
         self.cur_map = cur_map
         self.sum_map = self._create_state('sum_map', 'float32', [1])
-        self.batch_count = self._create_state('batches', 'float32', [1])
-        self._accumulate(self.sum_map, cur_map)
-        one = layers.fill_constant([1], 'float32', 1.0)
-        self._accumulate(self.batch_count, one)
-        self.metrics = [cur_map]
+        self.weight_sum = self._create_state('weight_sum', 'float32', [1])
+        if detect_count is not None:
+            wt = layers.reduce_sum(layers.cast(detect_count, 'float32'))
+            wt = layers.reshape(wt, [1])
+        else:
+            wt = layers.fill_constant([1], 'float32', 1.0)
+        self._accumulate(self.sum_map, cur_map * wt)
+        self._accumulate(self.weight_sum, wt)
+        # in-graph accumulated mean, fetchable every batch (parity with the
+        # reference's accum_map output of detection_map's accumulating mode)
+        self.accum_map = self.sum_map / layers.elementwise_max(
+            self.weight_sum, layers.fill_constant([1], 'float32', 1e-6))
+        self.metrics = [cur_map, self.accum_map]
 
     def get_map_var(self):
-        return self.cur_map, self.sum_map
+        return self.cur_map, self.accum_map
 
     def eval(self, executor, eval_program=None):
         s = float(self._state_value(self.sum_map).sum())
-        n = float(self._state_value(self.batch_count).sum())
+        n = float(self._state_value(self.weight_sum).sum())
         return np.array(s / n if n else 0.0, 'float32')

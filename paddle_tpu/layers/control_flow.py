@@ -16,10 +16,10 @@ from ..core.layer_helper import LayerHelper
 from . import tensor as tensor_layers
 from . import nn as nn_layers
 
-__all__ = ['While', 'Switch', 'increment', 'array_write', 'create_array',
-           'less_than', 'equal', 'array_read', 'array_length', 'IfElse',
-           'DynamicRNN', 'StaticRNN', 'reorder_lod_tensor_by_rank', 'Print',
-           'is_empty']
+__all__ = ['While', 'Switch', 'ConditionalBlock', 'increment', 'array_write',
+           'create_array', 'less_than', 'equal', 'array_read', 'array_length',
+           'IfElse', 'DynamicRNN', 'StaticRNN', 'reorder_lod_tensor_by_rank',
+           'Print', 'is_empty']
 
 
 def increment(x, value=1.0, in_place=True):
@@ -63,22 +63,63 @@ def is_empty(x, cond=None):
 # ----------------------------------------------------------- tensor array
 
 class _TensorArray(object):
-    """Python-level tensor array: a list of same-shaped Variables.
+    """Tensor array with a dual representation.
 
-    The reference's LoDTensorArray is a C++ vector<LoDTensor> manipulated by
-    array_write/array_read ops at runtime; with whole-block XLA lowering the
-    array structure must be static, so it lives at graph-build level.
-    Dynamic indexed access inside While loops should use stacked tensors +
-    gather instead.
+    Parity: reference LoDTensorArray (a C++ vector<LoDTensor> mutated by
+    lod_array ops at runtime).  TPU-native split:
+
+    * **Build-level** (``self.vars`` list): writes at statically-known
+      indices outside control-flow sub-blocks just track Variables in
+      Python — reads resolve to the variable directly and the "array"
+      never exists at runtime (StaticRNN / beam-search builders).
+    * **Graph-level** (``self.var``): a write with a runtime index, or any
+      write inside a While/conditional sub-block, upgrades the array to a
+      graph variable carried as a fixed-capacity stacked buffer + length
+      (core/control_flow_exec.TensorArrayVal).  Capacity comes from the
+      enclosing loop's static bound or an explicit ``capacity=``.
     """
 
-    def __init__(self, dtype='float32'):
+    def __init__(self, dtype='float32', capacity=None):
         self.dtype = dtype
+        self.capacity = capacity
         self.vars = []
+        self.var = None          # graph Variable once upgraded
+        self.elem_shape = None
+
+    def _to_graph(self):
+        if self.var is not None:
+            return self.var
+        from ..core import unique_name
+        prog = default_main_program()
+        root = prog.global_block()
+        v = root.create_var(name=unique_name.generate('tensor_array'),
+                            dtype=self.dtype, shape=None)
+        v.is_tensor_array = True
+        self.var = v
+        # migrate build-level entries: they must land in the buffer before
+        # any runtime write, so the writes go at the root block (which is
+        # always positionally before any not-yet-appended while op)
+        for idx, x in enumerate(self.vars):
+            iv = root.create_var(
+                name=unique_name.generate('ta_idx'), dtype='int64',
+                shape=(1,))
+            root.append_op(type='fill_constant', inputs={},
+                           outputs={'Out': iv},
+                           attrs={'shape': [1], 'dtype': 'int64',
+                                  'value': idx})
+            root.append_op(type='write_to_array',
+                           inputs={'X': x, 'I': iv, 'A': v},
+                           outputs={'Out': v},
+                           attrs={'capacity': self.capacity},
+                           infer_shape=False)
+            if x.shape is not None:
+                self.elem_shape = tuple(x.shape)
+        self.vars = []
+        return v
 
 
-def create_array(dtype):
-    return _TensorArray(dtype)
+def create_array(dtype, capacity=None):
+    return _TensorArray(dtype, capacity=capacity)
 
 
 def _static_index(i):
@@ -92,32 +133,60 @@ def _static_index(i):
     return None
 
 
+def _in_sub_block():
+    return default_main_program().current_block().parent_idx >= 0
+
+
 def array_write(x, i, array=None):
     if array is None:
         array = create_array(x.dtype)
     idx = _static_index(i)
-    if idx is None or idx == len(array.vars):
-        array.vars.append(x)
-    else:
-        while len(array.vars) <= idx:
-            array.vars.append(x)
+    if array.var is None and idx is not None and not _in_sub_block():
+        # build-level path: array never materializes at runtime
+        if idx >= len(array.vars):
+            while len(array.vars) <= idx:
+                array.vars.append(x)
         array.vars[idx] = x
+        return array
+    v = array._to_graph()
+    if x.shape is not None:
+        array.elem_shape = tuple(x.shape)
+    default_main_program().current_block().append_op(
+        type='write_to_array', inputs={'X': x, 'I': i, 'A': v},
+        outputs={'Out': v}, attrs={'capacity': array.capacity},
+        infer_shape=False)
     return array
 
 
 def array_read(array, i):
-    idx = _static_index(i)
-    if idx is not None and idx < len(array.vars):
-        return array.vars[idx]
-    # dynamic read: stack + gather
-    stacked = nn_layers.stack(array.vars, axis=0)
-    iv = tensor_layers.cast(i, 'int64')
-    row = nn_layers.gather(stacked, iv)
-    return nn_layers.squeeze(row, axes=[0])
+    if array.var is None:
+        idx = _static_index(i)
+        if idx is not None and idx < len(array.vars) and not _in_sub_block():
+            return array.vars[idx]
+        if array.vars:
+            # dynamic read of a build-level array: stack + gather
+            stacked = nn_layers.stack(array.vars, axis=0)
+            iv = tensor_layers.cast(i, 'int64')
+            row = nn_layers.gather(stacked, iv)
+            return nn_layers.squeeze(row, axes=[0])
+    v = array._to_graph()
+    helper = LayerHelper('array_read')
+    out = helper.create_variable_for_type_inference(array.dtype)
+    out.shape = array.elem_shape
+    helper.append_op(type='read_from_array', inputs={'A': v, 'I': i},
+                     outputs={'Out': out}, attrs={}, infer_shape=False)
+    return out
 
 
 def array_length(array):
-    return tensor_layers.fill_constant([1], 'int64', len(array.vars))
+    if array.var is None:
+        return tensor_layers.fill_constant([1], 'int64', len(array.vars))
+    helper = LayerHelper('array_length')
+    out = helper.create_variable_for_type_inference('int64')
+    out.shape = (1,)
+    helper.append_op(type='array_length', inputs={'A': array.var},
+                     outputs={'Out': out}, attrs={}, infer_shape=False)
+    return out
 
 
 # ----------------------------------------------------------- While
@@ -153,6 +222,47 @@ class While(object):
                 parent.append_op(
                     type='while',
                     inputs={'Condition': self.cond_var},
+                    outputs={},
+                    attrs={'sub_block': sub.idx},
+                    infer_shape=False)
+        return cm()
+
+
+class ConditionalBlock(object):
+    """Run a sub-block only when a boolean condition holds.
+
+    Parity: reference control_flow.py ConditionalBlock /
+    paddle/fluid/operators/conditional_block_op.cc.  Lowered to `lax.cond`
+    over the vars the body writes (core/control_flow_exec.py) — the false
+    branch passes them through unchanged, so vars assigned in the body must
+    exist beforehand to be visible after the block.
+    """
+
+    def __init__(self, inputs, is_scalar_condition=False, name=None):
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self.cond_vars = list(inputs)
+        self.helper = LayerHelper('conditional_block', name=name)
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            prog = default_main_program()
+            parent = prog.current_block()
+            sub = prog._create_block()
+            try:
+                yield
+            finally:
+                prog._rollback()
+                cond = self.cond_vars[0]
+                if len(self.cond_vars) > 1:
+                    for c in self.cond_vars[1:]:
+                        cond = nn_layers.logical_and(cond, c)
+                parent.append_op(
+                    type='conditional_block',
+                    inputs={'Condition': cond},
                     outputs={},
                     attrs={'sub_block': sub.idx},
                     infer_shape=False)

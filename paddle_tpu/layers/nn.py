@@ -195,8 +195,12 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
     D = size // 4
     weight = helper.create_parameter(helper.param_attr,
                                      [proj_size, 4 * D], dtype)
-    proj_weight = helper.create_parameter(
-        ParamAttr._to_attr(param_attr), [D, proj_size], dtype)
+    proj_attr = ParamAttr._to_attr(param_attr)
+    if proj_attr.name is not None:
+        # a named param_attr must not alias weight and proj_weight
+        proj_attr = ParamAttr(name=proj_attr.name + '_proj',
+                              initializer=proj_attr.initializer)
+    proj_weight = helper.create_parameter(proj_attr, [D, proj_size], dtype)
     bias_size = [1, 7 * D] if use_peepholes else [1, 4 * D]
     bias = helper.create_parameter(helper.bias_attr, bias_size, dtype,
                                    is_bias=True)
@@ -1512,10 +1516,12 @@ def warpctc(input, label, blank=0, norm_by_times=False,
 def ctc_greedy_decoder(input, blank, name=None):
     helper = LayerHelper('ctc_greedy_decoder', name=name)
     out = helper.create_variable_for_type_inference('int64')
+    out_len = helper.create_variable_for_type_inference('int32')
     helper.append_op(type='ctc_align', inputs=_seq_inputs(input),
-                     outputs={'Output': out}, attrs={'blank': blank,
-                                                     'merge_repeated': True})
-    _copy_lod(input, out)
+                     outputs={'Output': out, 'OutLength': out_len},
+                     attrs={'blank': blank, 'merge_repeated': True})
+    out.lod_level = 1
+    out.lod_length_name = out_len.name
     return out
 
 
@@ -1585,8 +1591,14 @@ def linear_chain_crf(input, label, param_attr=None):
 
 def crf_decoding(input, param_attr, label=None):
     helper = LayerHelper('crf_decoding', param_attr=param_attr)
-    transition = helper.param_attr.name
-    tvar = input.block.var(transition)
+    tname = helper.param_attr.name
+    tvar = input.block._find_var_recursive(tname) if tname else None
+    if tvar is None:
+        # standalone decode: create the transition param (shared by name
+        # with linear_chain_crf when both are built, like the reference)
+        size = input.shape[-1]
+        tvar = helper.create_parameter(helper.param_attr,
+                                       [size + 2, size], input.dtype)
     out = helper.create_variable_for_type_inference('int64')
     ins = _seq_inputs(input, {'Transition': tvar})
     if label is not None:

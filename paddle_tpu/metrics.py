@@ -13,17 +13,19 @@ class MetricBase(object):
         return self._name
 
     def reset(self):
-        states = {attr: value for attr, value in self.__dict__.items()
-                  if not attr.startswith('_')}
-        for attr, value in states.items():
-            if isinstance(value, int):
-                setattr(self, attr, 0)
-            elif isinstance(value, float):
-                setattr(self, attr, .0)
-            elif isinstance(value, (np.ndarray, np.generic)):
-                setattr(self, attr, np.zeros_like(value))
+        """Zero every public accumulator attribute in place, keeping its
+        type (numerics to zero, arrays to zeros, anything else cleared)."""
+        for attr in list(vars(self)):
+            if attr.startswith('_'):
+                continue
+            cur = getattr(self, attr)
+            if isinstance(cur, (np.ndarray, np.generic)):
+                new = np.zeros_like(cur)
+            elif isinstance(cur, (int, float)):
+                new = type(cur)(0)
             else:
-                setattr(self, attr, None)
+                new = None
+            setattr(self, attr, new)
 
     def update(self, preds, labels):
         raise NotImplementedError()

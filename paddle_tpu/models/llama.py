@@ -293,6 +293,9 @@ def make_decoder(scope, config='tiny', temperature=0.0, **overrides):
 
     def run(prompt_ids, max_new, seed=0):
         import numpy as np
+        if max_new <= 0:
+            # prefill would still emit one token; zero requested -> no-op
+            return np.asarray(prompt_ids)
         prompt = jnp.asarray(np.asarray(prompt_ids), jnp.int32)
         if prompt.shape[1] + max_new > Tmax:
             raise ValueError('prompt+max_new exceeds max_len=%d' % Tmax)

@@ -14,3 +14,4 @@ from . import metric  # noqa
 from . import sequence  # noqa
 from . import detection  # noqa
 from . import attention  # noqa
+from . import ctc_crf  # noqa

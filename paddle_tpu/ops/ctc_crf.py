@@ -1,0 +1,299 @@
+"""CTC + linear-chain CRF + projection-LSTM ops.
+
+Parity: reference paddle/fluid/operators/warpctc_op.cc (wraps Baidu
+warp-ctc), ctc_align_op.cc, linear_chain_crf_op.{h,cc}, crf_decoding_op.cc,
+lstmp_op.cc.
+
+TPU-native redesign: the reference dispatches hand-written CPU/CUDA kernels
+per sequence over LoD offset tables.  Here every recursion is a log-space
+`lax.scan` over the padded time axis, batch-vectorized (vmap / dense masks),
+so the whole loss lowers into the surrounding XLA program and the backward
+pass comes from autodiff of the scan — no custom gradient kernels.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+from .sequence import _length_or_full, _lstm_scan, _ACTS
+
+_NEG = -1e30  # log-space "minus infinity" that survives bf16/f32 adds
+
+
+def _squeeze_label(lab):
+    if lab.ndim >= 2 and lab.shape[-1] == 1:
+        lab = lab.reshape(lab.shape[:-1])
+    return lab
+
+
+# ------------------------------------------------------------------ CTC
+
+def _ctc_nll_single(logp, labels, T_len, L_len, blank):
+    """Negative log-likelihood of one sequence.
+
+    logp: [T, C] log-softmax scores; labels: [L] int32; T_len/L_len scalars.
+    Classic alpha recursion over the extended label string
+    [blank, l1, blank, ..., lL, blank] (S = 2L+1), log-space.
+    """
+    T, C = logp.shape
+    L = labels.shape[0]
+    S = 2 * L + 1
+    ext = jnp.full((S,), blank, jnp.int32).at[1::2].set(
+        labels.astype(jnp.int32))
+    # skip connection s-2 -> s allowed where ext[s] is a label differing
+    # from ext[s-2]
+    prev2 = jnp.concatenate([jnp.full((2,), -1, jnp.int32), ext[:-2]])
+    can_skip = (ext != blank) & (ext != prev2)
+    svalid = jnp.arange(S) < 2 * L_len + 1
+
+    lp0 = logp[0]
+    alpha0 = jnp.full((S,), _NEG)
+    alpha0 = alpha0.at[0].set(lp0[blank])
+    alpha0 = jnp.where((jnp.arange(S) == 1) & (L_len > 0),
+                       lp0[ext[1]], alpha0)
+
+    def step(alpha, t):
+        lp = logp[t]
+        a1 = alpha
+        a2 = jnp.concatenate([jnp.array([_NEG]), alpha[:-1]])
+        a3 = jnp.where(can_skip,
+                       jnp.concatenate([jnp.full((2,), _NEG), alpha[:-2]]),
+                       _NEG)
+        stacked = jnp.stack([a1, a2, a3])
+        m = jnp.max(stacked, axis=0)
+        new = m + jnp.log(jnp.sum(jnp.exp(stacked - m), axis=0))
+        new = new + lp[ext]
+        new = jnp.where(svalid, new, _NEG)
+        # freeze once past this sequence's last frame
+        return jnp.where(t < T_len, new, alpha), None
+
+    alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    S_end = 2 * L_len  # index of final blank in the extended string
+    last_blank = alphaT[S_end]
+    last_label = jnp.where(S_end - 1 >= 0, alphaT[jnp.maximum(S_end - 1, 0)],
+                           _NEG)
+    m = jnp.maximum(last_blank, last_label)
+    ll = m + jnp.log(jnp.exp(last_blank - m) + jnp.exp(last_label - m))
+    return -ll
+
+
+@register('warpctc')
+def warpctc(ctx, ins, attrs):
+    """CTC loss (ref warpctc_op.cc:1).  Logits [B, T, C] unnormalized;
+    Label [B, L] int; per-sequence total NLL out as [B, 1]."""
+    logits = ins['Logits']
+    labels = _squeeze_label(ins['Label'])
+    blank = int(attrs.get('blank', 0))
+    T_lens = (ins['LogitsLength'] if ins.get('LogitsLength') is not None
+              else jnp.full((logits.shape[0],), logits.shape[1], jnp.int32))
+    L_lens = (ins['LabelLength'] if ins.get('LabelLength') is not None
+              else jnp.full((labels.shape[0],), labels.shape[1], jnp.int32))
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = jax.vmap(_ctc_nll_single, in_axes=(0, 0, 0, 0, None))(
+        logp, labels, T_lens.astype(jnp.int32), L_lens.astype(jnp.int32),
+        blank)
+    if attrs.get('norm_by_times'):
+        nll = nll / jnp.maximum(T_lens.astype(nll.dtype), 1.0)
+    return {'Loss': nll[:, None].astype(logits.dtype)}
+
+
+@register('ctc_align')
+def ctc_align(ctx, ins, attrs):
+    """Greedy CTC decode (ref ctc_align_op.cc:1): argmax per frame, merge
+    repeats, drop blanks; zero-padded output + OutLength."""
+    x = ins['X']
+    blank = int(attrs.get('blank', 0))
+    merge = bool(attrs.get('merge_repeated', True))
+    if x.ndim == 3:  # raw probs/logits: take the greedy path first
+        tok = jnp.argmax(x, axis=-1).astype(jnp.int32)
+    else:
+        tok = _squeeze_label(x).astype(jnp.int32)
+    B, T = tok.shape
+    length = _length_or_full(ins, x).astype(jnp.int32)
+    valid = jnp.arange(T)[None, :] < length[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, jnp.int32), tok[:, :-1]],
+                           axis=1)
+    keep = valid & (tok != blank)
+    if merge:
+        keep = keep & (tok != prev)
+
+    def compact(row_tok, row_keep):
+        pos = jnp.cumsum(row_keep) - 1
+        safe = jnp.where(row_keep, pos, T)
+        return jnp.zeros((T + 1,), jnp.int32).at[safe].set(row_tok)[:T]
+
+    out = jax.vmap(compact)(tok, keep)
+    out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return {'Output': out.astype(jnp.int64), 'OutLength': out_len}
+
+
+# ------------------------------------------------------------------ CRF
+
+def _crf_unpack(transition):
+    """Reference layout (linear_chain_crf_op.h:1): row 0 = start weights,
+    row 1 = stop weights, rows 2: = [C, C] tag-transition matrix."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register('linear_chain_crf')
+def linear_chain_crf(ctx, ins, attrs):
+    """Linear-chain CRF negative log-likelihood (a cost, like the
+    reference: conll05 does mean(crf_cost) and minimizes it)."""
+    x = ins['X']                       # [B, T, C] emissions
+    transition = ins['Transition']     # [C+2, C]
+    labels = _squeeze_label(ins['Label']).astype(jnp.int32)  # [B, T]
+    length = _length_or_full(ins, x).astype(jnp.int32)
+    start_w, stop_w, w = _crf_unpack(transition.astype(jnp.float32))
+    xf = x.astype(jnp.float32)
+    B, T, C = xf.shape
+    tpos = jnp.arange(T)
+
+    def one(xb, lb, nb):
+        alpha0 = start_w + xb[0]
+
+        def step(carry, t):
+            alpha, score, final_alpha, prev_lab = carry
+            # partition function recursion
+            scores = alpha[:, None] + w                 # [C_from, C_to]
+            m = jnp.max(scores, axis=0)
+            new_alpha = m + jnp.log(
+                jnp.sum(jnp.exp(scores - m), axis=0)) + xb[t]
+            # gold-path score increment
+            new_score = score + w[prev_lab, lb[t]] + xb[t, lb[t]]
+            live = t < nb
+            alpha = jnp.where(live, new_alpha, alpha)
+            score = jnp.where(live, new_score, score)
+            final_alpha = jnp.where(live, new_alpha, final_alpha)
+            prev_lab = jnp.where(live, lb[t], prev_lab)
+            return (alpha, score, final_alpha, prev_lab), new_alpha
+
+        init_score = start_w[lb[0]] + xb[0, lb[0]]
+        (alpha, score, final_alpha, last_lab), alphas = lax.scan(
+            step, (alpha0, init_score, alpha0, lb[0]), tpos[1:])
+        score = score + stop_w[last_lab]
+        z_terms = final_alpha + stop_w
+        m = jnp.max(z_terms)
+        logz = m + jnp.log(jnp.sum(jnp.exp(z_terms - m)))
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+        return logz - score, alphas
+
+    nll, alphas = jax.vmap(one)(xf, labels, length)
+    return {'LogLikelihood': nll[:, None].astype(x.dtype),
+            'Alpha': alphas.astype(x.dtype),
+            'EmissionExps': jnp.exp(xf).astype(x.dtype),
+            'TransitionExps': jnp.exp(transition)}
+
+
+@register('crf_decoding')
+def crf_decoding(ctx, ins, attrs):
+    """Viterbi decode (ref crf_decoding_op.h:1).  With Label given, emits
+    the per-token correctness indicator instead (reference semantics)."""
+    x = ins['X']
+    transition = ins['Transition']
+    length = _length_or_full(ins, x).astype(jnp.int32)
+    start_w, stop_w, w = _crf_unpack(transition.astype(jnp.float32))
+    xf = x.astype(jnp.float32)
+    B, T, C = xf.shape
+    tpos = jnp.arange(T)
+
+    def one(xb, nb):
+        alpha0 = start_w + xb[0]
+
+        def fwd(carry, t):
+            alpha = carry
+            scores = alpha[:, None] + w + xb[t][None, :]
+            best_from = jnp.argmax(scores, axis=0)
+            new_alpha = jnp.max(scores, axis=0)
+            live = t < nb
+            alpha = jnp.where(live, new_alpha, alpha)
+            # frozen steps keep identity backpointers so backtracking
+            # passes through them untouched
+            bp = jnp.where(live, best_from, jnp.arange(C))
+            return alpha, bp
+
+        alphaT, bps = lax.scan(fwd, alpha0, tpos[1:])  # bps: [T-1, C]
+        last = jnp.argmax(alphaT + stop_w).astype(jnp.int32)
+
+        def back(carry, bp):
+            tag = carry
+            return bp[tag].astype(jnp.int32), tag
+
+        first, rev_path = lax.scan(back, last, bps, reverse=True)
+        path = jnp.concatenate([first[None], rev_path])
+        return jnp.where(tpos < nb, path, 0)
+
+    path = jax.vmap(one)(xf, length)
+    if ins.get('Label') is not None:
+        lab = _squeeze_label(ins['Label']).astype(path.dtype)
+        valid = tpos[None, :] < length[:, None]
+        return {'ViterbiPath':
+                (jnp.where(valid, path == lab, False)).astype(jnp.int64)}
+    return {'ViterbiPath': path.astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------- lstmp
+
+@register('lstmp')
+def lstmp(ctx, ins, attrs):
+    """LSTM with recurrent projection (ref lstmp_op.cc:1): the projection
+    r_t = proj_act(h_t @ ProjWeight) feeds back into the gates, so the
+    recurrent GEMM is [P, 4D] instead of [D, 4D]."""
+    x = ins['Input']                 # [B, T, 4D] pre-projected
+    w = ins['Weight']                # [P, 4D]
+    pw = ins['ProjWeight']           # [D, P]
+    bias = ins['Bias']
+    length = _length_or_full(ins, x)
+    D = pw.shape[0]
+    P = pw.shape[1]
+    B, T, _ = x.shape
+    gate_act = _ACTS[attrs.get('gate_activation', 'sigmoid')]
+    cell_act = _ACTS[attrs.get('cell_activation', 'tanh')]
+    cand_act = _ACTS[attrs.get('candidate_activation', 'tanh')]
+    proj_act = _ACTS[attrs.get('proj_activation', 'tanh')]
+    use_peep = attrs.get('use_peepholes', True)
+    is_rev = attrs.get('is_reverse', False)
+
+    if is_rev:
+        x = jnp.flip(x, axis=1)
+    tmask = (jnp.arange(T)[None, :] < length[:, None]).astype(x.dtype)
+    if is_rev:
+        tmask = jnp.flip(tmask, axis=1)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(tmask, 0, 1)
+    if use_peep:
+        b_g, w_ic, w_fc, w_oc = (bias[:, :4 * D], bias[:, 4 * D:5 * D],
+                                 bias[:, 5 * D:6 * D], bias[:, 6 * D:7 * D])
+    else:
+        b_g = bias
+        w_ic = w_fc = w_oc = None
+
+    def step(carry, inp):
+        r, c = carry
+        xt, mt = inp
+        gates = xt + r @ w + b_g
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = gate_act(i), gate_act(f)
+        g = cand_act(g)
+        c_new = f * c + i * g
+        if use_peep:
+            o = o + c_new * w_oc
+        o = gate_act(o)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ pw)
+        m = mt[:, None]
+        r = m * r_new + (1 - m) * r
+        c = m * c_new + (1 - m) * c
+        return (r, c), (r, c)
+
+    r0 = jnp.zeros((B, P), x.dtype)
+    c0 = jnp.zeros((B, D), x.dtype)
+    _, (rs, cs) = lax.scan(step, (r0, c0), (xs, ms))
+    rs = jnp.swapaxes(rs, 0, 1)
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_rev:
+        rs = jnp.flip(rs, axis=1)
+        cs = jnp.flip(cs, axis=1)
+    return {'Projection': rs, 'Cell': cs}

@@ -432,3 +432,18 @@ def fake_quantize_dequantize_moving_average_abs_max(ctx, ins, attrs):
     qdq = q / rmax * safe
     out = x + lax.stop_gradient(qdq - x)
     return {'Out': out, 'OutScale': new_state.reshape(1)}
+
+
+@register('quantize_dequantize_fixed_scale')
+def quantize_dequantize_fixed_scale(ctx, ins, attrs):
+    """Inference-time quantize/dequantize at a frozen scale (the trained
+    moving-average abs-max recorded during QAT).  Emitted by
+    contrib.quantize freeze_program so the frozen graph's activation
+    numerics match what QAT simulated (ref freeze pass keeps
+    quantize/dequantize pairs with recorded scales)."""
+    x = ins['X']
+    bits = attrs.get('bit_length', 8)
+    rmax = float(2 ** (bits - 1) - 1)
+    safe = max(float(attrs['scale']), 1e-8)
+    q = jnp.clip(jnp.round(x / safe * rmax), -rmax, rmax)
+    return {'Out': (q / rmax * safe).astype(x.dtype)}

@@ -45,7 +45,8 @@ def init_parallel_env(coordinator_address=None, num_processes=None,
         if n:
             num_processes = int(n)
         elif eps:
-            num_processes = len([e for e in eps.split(',') if e])
+            num_processes = len(
+                [e for e in eps.replace('\n', ',').split(',') if e])
     if process_id is None:
         tid = _env('PADDLE_TRAINER_ID')
         if tid is not None:

@@ -1,0 +1,247 @@
+"""Numeric tests for the CTC / CRF / lstmp op family vs plain-numpy
+references (model: reference tests/unittests/test_warpctc_op.py,
+test_ctc_align_op.py, test_linear_chain_crf_op.py, test_crf_decoding_op.py,
+test_lstmp_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import LoDTensor
+
+
+# ------------------------------------------------------- numpy references
+
+def np_ctc_nll(logits, labels, blank=0):
+    """Brute-force CTC -log p(l|x) by enumerating the alpha recursion in
+    float64 (single sequence)."""
+    T, C = logits.shape
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    L = len(labels)
+    ext = [blank]
+    for l in labels:
+        ext += [l, blank]
+    S = len(ext)
+    alpha = np.zeros((T, S))
+    alpha[0, 0] = probs[0, blank]
+    if S > 1:
+        alpha[0, 1] = probs[0, ext[1]]
+    for t in range(1, T):
+        for s in range(S):
+            a = alpha[t - 1, s]
+            if s - 1 >= 0:
+                a += alpha[t - 1, s - 1]
+            if s - 2 >= 0 and ext[s] != blank and ext[s] != ext[s - 2]:
+                a += alpha[t - 1, s - 2]
+            alpha[t, s] = a * probs[t, ext[s]]
+    p = alpha[T - 1, S - 1] + (alpha[T - 1, S - 2] if S > 1 else 0.0)
+    return -np.log(p)
+
+
+def np_crf_nll(x, labels, trans):
+    """Forward-algorithm NLL for one sequence, float64."""
+    start, stop, w = trans[0], trans[1], trans[2:]
+    T, C = x.shape
+    alpha = start + x[0]
+    for t in range(1, T):
+        alpha = np.log(np.exp(
+            alpha[:, None] + w).sum(0)) + x[t]
+    logz = np.log(np.exp(alpha + stop).sum())
+    score = start[labels[0]] + x[0, labels[0]]
+    for t in range(1, T):
+        score += w[labels[t - 1], labels[t]] + x[t, labels[t]]
+    score += stop[labels[-1]]
+    return logz - score
+
+
+def np_viterbi(x, trans):
+    start, stop, w = trans[0], trans[1], trans[2:]
+    T, C = x.shape
+    alpha = start + x[0]
+    bps = []
+    for t in range(1, T):
+        scores = alpha[:, None] + w + x[t][None, :]
+        bps.append(scores.argmax(0))
+        alpha = scores.max(0)
+    path = [int((alpha + stop).argmax())]
+    for bp in reversed(bps):
+        path.append(int(bp[path[-1]]))
+    return np.array(path[::-1])
+
+
+# ----------------------------------------------------------------- tests
+
+def test_warpctc_matches_numpy():
+    rng = np.random.RandomState(0)
+    B, T, C, L = 3, 8, 5, 3
+    logits = rng.randn(B, T, C).astype('float32')
+    labels = rng.randint(1, C, (B, L)).astype('int64')
+    t_lens = np.array([8, 6, 7], 'int32')
+    l_lens = np.array([3, 2, 1], 'int32')
+
+    x = fluid.layers.data('x', shape=[C], dtype='float32', lod_level=1)
+    lab = fluid.layers.data('lab', shape=[1], dtype='int64', lod_level=1)
+    loss = layers.warpctc(x, lab, blank=0)
+    exe = fluid.Executor()
+    out, = exe.run(feed={'x': LoDTensor(logits, t_lens),
+                         'lab': LoDTensor(labels[..., None], l_lens)},
+                   fetch_list=[loss])
+    for b in range(B):
+        want = np_ctc_nll(logits[b, :t_lens[b]].astype('float64'),
+                          labels[b, :l_lens[b]])
+        np.testing.assert_allclose(out[b, 0], want, rtol=2e-4)
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(1)
+    B, T, C, L = 2, 6, 4, 2
+    feats = rng.randn(B, T, 3).astype('float32')
+    labels = rng.randint(1, C, (B, L)).astype('int64')
+
+    x = fluid.layers.data('x', shape=[T, 3], dtype='float32')
+    lab = fluid.layers.data('lab', shape=[L], dtype='int64')
+    logits = fluid.layers.fc(x, C, num_flatten_dims=2)
+    loss = layers.mean(layers.warpctc(logits, lab, blank=0))
+    fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(30):
+        lv, = exe.run(feed={'x': feats, 'lab': labels}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::10]
+
+
+def test_ctc_greedy_decoder():
+    # frames argmax to [b b 1 1 b 2 2 b] -> decoded [1, 2]
+    T, C = 8, 4
+    path = [0, 0, 1, 1, 0, 2, 2, 0]
+    probs = np.full((1, T, C), -5.0, 'float32')
+    for t, c in enumerate(path):
+        probs[0, t, c] = 5.0
+    x = fluid.layers.data('x', shape=[T, C], dtype='float32')
+    dec = layers.ctc_greedy_decoder(x, blank=0)
+    exe = fluid.Executor()
+    out, = exe.run(feed={'x': probs}, fetch_list=[dec])
+    assert list(out[0][:2]) == [1, 2]
+    assert (out[0][2:] == 0).all()
+
+
+def test_linear_chain_crf_matches_numpy():
+    rng = np.random.RandomState(2)
+    B, T, C = 3, 6, 4
+    x = rng.randn(B, T, C).astype('float32') * 0.5
+    labels = rng.randint(0, C, (B, T)).astype('int64')
+    trans = (rng.randn(C + 2, C) * 0.3).astype('float32')
+    lens = np.array([6, 4, 5], 'int32')
+
+    xv = fluid.layers.data('x', shape=[C], dtype='float32', lod_level=1)
+    lv = fluid.layers.data('lab', shape=[1], dtype='int64', lod_level=1)
+    cost = layers.linear_chain_crf(
+        xv, lv, param_attr=fluid.ParamAttr(name='crf_w'))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set('crf_w', trans)
+    out, = exe.run(feed={'x': LoDTensor(x, lens),
+                         'lab': LoDTensor(labels[..., None], lens)},
+                   fetch_list=[cost])
+    for b in range(B):
+        want = np_crf_nll(x[b, :lens[b]].astype('float64'),
+                          labels[b, :lens[b]], trans.astype('float64'))
+        np.testing.assert_allclose(out[b, 0], want, rtol=2e-4)
+
+
+def test_crf_decoding_matches_numpy():
+    rng = np.random.RandomState(3)
+    B, T, C = 2, 5, 3
+    x = rng.randn(B, T, C).astype('float32')
+    trans = (rng.randn(C + 2, C) * 0.5).astype('float32')
+    lens = np.array([5, 3], 'int32')
+
+    xv = fluid.layers.data('x', shape=[C], dtype='float32', lod_level=1)
+    path = layers.crf_decoding(xv, param_attr=fluid.ParamAttr(name='crf_d'))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set('crf_d', trans)
+    out, = exe.run(feed={'x': LoDTensor(x, lens)}, fetch_list=[path])
+    for b in range(B):
+        want = np_viterbi(x[b, :lens[b]].astype('float64'),
+                          trans.astype('float64'))
+        np.testing.assert_array_equal(out[b, :lens[b]], want)
+        assert (out[b, lens[b]:] == 0).all()
+
+
+def test_crf_train_improves_decoding():
+    """Sequence labeling end-to-end: emissions + CRF learn a trivial
+    tagging rule (tag = feature argmax)."""
+    rng = np.random.RandomState(4)
+    B, T, C = 8, 5, 3
+    feats = rng.randn(B, T, C).astype('float32')
+    labels = feats.argmax(-1).astype('int64')
+
+    x = fluid.layers.data('x', shape=[T, C], dtype='float32')
+    lab = fluid.layers.data('lab', shape=[T], dtype='int64')
+    emission = fluid.layers.fc(x, C, num_flatten_dims=2)
+    cost = layers.linear_chain_crf(
+        emission, lab, param_attr=fluid.ParamAttr(name='crf_t'))
+    loss = layers.mean(cost)
+    fluid.optimizer.Adam(0.1).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    first = None
+    for i in range(40):
+        lv, = exe.run(feed={'x': feats, 'lab': labels}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+    assert float(lv) < first * 0.5, (first, float(lv))
+
+
+def test_dynamic_lstmp_shapes_and_projection():
+    rng = np.random.RandomState(5)
+    B, T, D, P = 2, 7, 6, 3
+    x = rng.randn(B, T, 4 * D).astype('float32')
+    xv = fluid.layers.data('x', shape=[T, 4 * D], dtype='float32')
+    proj, cell = fluid.layers.dynamic_lstmp(xv, size=4 * D, proj_size=P)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    pv, cv = exe.run(feed={'x': x}, fetch_list=[proj, cell])
+    assert pv.shape == (B, T, P)
+    assert cv.shape == (B, T, D)
+    assert np.isfinite(pv).all() and np.isfinite(cv).all()
+    # projection output bounded by tanh
+    assert np.abs(pv).max() <= 1.0
+
+
+def test_dynamic_lstmp_matches_numpy_step():
+    """One-timestep lstmp against a hand-rolled numpy step (no peepholes)."""
+    rng = np.random.RandomState(6)
+    B, D, P = 2, 4, 3
+    x = rng.randn(B, 1, 4 * D).astype('float32')
+    w = rng.randn(P, 4 * D).astype('float32') * 0.3
+    pw = rng.randn(D, P).astype('float32') * 0.3
+    b = rng.randn(1, 4 * D).astype('float32') * 0.1
+
+    xv = fluid.layers.data('x', shape=[1, 4 * D], dtype='float32')
+    proj, cell = fluid.layers.dynamic_lstmp(
+        xv, size=4 * D, proj_size=P, use_peepholes=False,
+        param_attr=fluid.ParamAttr(name='lstmp_w'),
+        bias_attr=fluid.ParamAttr(name='lstmp_b'))
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set('lstmp_w', w)
+    fluid.global_scope().set('lstmp_w_proj', pw)
+    fluid.global_scope().set('lstmp_b', b)
+    pv, cv = exe.run(feed={'x': x}, fetch_list=[proj, cell])
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    gates = x[:, 0] + b  # r0 = 0
+    i, f, g, o = np.split(gates, 4, axis=-1)
+    c = sig(i) * np.tanh(g)
+    h = sig(o) * np.tanh(c)
+    r = np.tanh(h @ pw)
+    np.testing.assert_allclose(pv[:, 0], r, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cv[:, 0], c, rtol=1e-5, atol=1e-5)
