@@ -112,10 +112,13 @@ class QuantizeTranspiler(object):
         fake-quants are folded by re-quantizing the trained weights once
         on the host; activation fake-quants are REPLACED by fixed-scale
         quantize/dequantize ops using the trained moving-average scale
-        (parity: the reference freeze pass keeps quantize/dequantize with
-        recorded scales), so frozen numerics match what QAT simulated.
-        Activation quants with no recorded scale (abs_max mode) are kept
-        as-is — their scale is computed per batch at inference too."""
+        (parity: the reference freeze pass at
+        contrib/quantize/quantize_transpiler.py:218 removes only WEIGHT
+        fake-quants — storing weights pre-quantized — and keeps activation
+        quantization live in the inference graph), so frozen numerics match
+        what QAT simulated.  Activation quants with no recorded scale
+        (abs_max mode) are kept as-is: their scale is computed per batch at
+        inference too, exactly as during training."""
         from ..core.executor import global_scope
         scope = scope or global_scope()
         rmax = float(2 ** (self.weight_bits - 1) - 1)
