@@ -162,6 +162,14 @@ def main():
     n_params = sum(
         int(np.prod(v.shape)) for v in
         main_prog.global_block().all_parameters() if v.shape)
+    # params that only feed lookup_table gathers do 0 matmul FLOPs — count
+    # them out of the 6*P model-FLOPs term (the logit projection is a real
+    # matmul and keeps its '...proj...' name, so it stays in)
+    n_gather_params = sum(
+        int(np.prod(v.shape)) for v in
+        main_prog.global_block().all_parameters()
+        if v.shape and v.name.endswith('_emb'))
+    n_matmul_params = n_params - n_gather_params
 
     with fluid.scope_guard(scope):
         t0 = time.perf_counter()
@@ -184,10 +192,11 @@ def main():
     tps = steps * tokens_per_step / dt
 
     # model FLOPs (scaling-book accounting): 6*P per trained token for the
-    # dense stack, + 12*T*d per token per attention layer for the score /
-    # context matmuls (fwd 4*T*d, bwd x2); enc self + dec self + dec cross
+    # MATMUL params (embedding gathers excluded — they do no MXU work),
+    # + 12*T*d per token per attention layer for the score / context
+    # matmuls (fwd 4*T*d, bwd x2); enc self + dec self + dec cross
     attn_layers = 3 * n_layer
-    flops_per_token = 6.0 * n_params + 12.0 * T * d_model * attn_layers
+    flops_per_token = 6.0 * n_matmul_params + 12.0 * T * d_model * attn_layers
     model_flops_per_s = flops_per_token * tps
     peak = peak_flops(device_kind) if on_tpu else None
     mfu = round(model_flops_per_s / peak, 4) if peak else None
@@ -206,6 +215,7 @@ def main():
         'mfu': mfu,
         'model_tflops_per_s': round(model_flops_per_s / 1e12, 2),
         'params_m': round(n_params / 1e6, 1),
+        'matmul_params_m': round(n_matmul_params / 1e6, 1),
         'backend': device_kind,
         'batch': B, 'seq': T, 'amp': True, 'flash': True,
     }

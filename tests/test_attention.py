@@ -62,3 +62,37 @@ def test_gradient_parity():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(a, b, atol=2e-5)
+
+
+@pytest.mark.parametrize('cfg', [
+    dict(B=2, H=4, Hkv=4, Tq=128, Tk=128, D=32, causal=False, klen=False),
+    dict(B=2, H=4, Hkv=4, Tq=128, Tk=128, D=32, causal=True, klen=True),
+    dict(B=2, H=8, Hkv=2, Tq=128, Tk=128, D=32, causal=True, klen=False),
+    dict(B=2, H=8, Hkv=2, Tq=128, Tk=256, D=32, causal=True, klen=True),
+])
+def test_pallas_backward_kernels_gradient_parity(cfg, monkeypatch):
+    """The pallas dq/dkv kernels normally engage only above the HBM score
+    threshold (long-T); force them on so regressions surface here, not on
+    a long-sequence TPU run."""
+    from paddle_tpu.ops import attention as att
+    monkeypatch.setattr(att, '_BWD_PALLAS_SCORE_BYTES', 0)
+    rng = np.random.RandomState(9)
+    B, H, Hkv, Tq, Tk, D = (cfg[k] for k in 'B H Hkv Tq Tk D'.split())
+    q = rng.randn(B, H, Tq, D).astype('float32')
+    k = rng.randn(B, Hkv, Tk, D).astype('float32')
+    v = rng.randn(B, Hkv, Tk, D).astype('float32')
+    kl = (np.asarray(rng.randint(Tk // 2, Tk + 1, B), np.int32)
+          if cfg['klen'] else None)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=cfg['causal'], k_len=kl,
+                                block_q=64, block_k=64) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_ref_attention(q, k, v, cfg['causal'], D ** -0.5,
+                               kl) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(gf, gr, 'dq dk dv'.split()):
+        np.testing.assert_allclose(a, b, atol=5e-4, err_msg=n)
