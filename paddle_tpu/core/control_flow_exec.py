@@ -39,7 +39,7 @@ from jax import lax
 
 # ops this module executes natively (no registry impl, no shape inference)
 NATIVE_OPS = {'while', 'conditional_block', 'write_to_array',
-              'read_from_array', 'array_length'}
+              'read_from_array', 'array_length', 'recurrent'}
 
 # while loops with a static bound at or under this lower to a masked scan
 # (differentiable); larger/unknown bounds use lax.while_loop (forward-only)
@@ -175,6 +175,8 @@ def _is_array_var(block, name):
 def exec_control_flow_op(op, env, ectx, op_index, program):
     if op.type == 'while':
         _exec_while(op, env, ectx, program)
+    elif op.type == 'recurrent':
+        _exec_recurrent(op, env, ectx, program)
     elif op.type == 'conditional_block':
         _exec_cond_block(op, env, ectx, program)
     elif op.type == 'write_to_array':
@@ -271,6 +273,72 @@ def _exec_while(op, env, ectx, program):
     else:
         final = lax.while_loop(cond_of, body, init)
     env.update(final)
+
+
+# ----------------------------------------------------------- recurrent
+
+def _exec_recurrent(op, env, ectx, program):
+    """Lower a `recurrent` op (StaticRNN / DynamicRNN step block) to ONE
+    `lax.scan` over the time axis.
+
+    Parity: reference paddle/fluid/operators/recurrent_op.cc, which
+    re-interprets the step block per time step on the host with memory
+    linkage.  Here the step block is traced once and scanned — XLA sees a
+    single fused loop body, reverse-differentiable for training.
+
+    attrs:
+      sub_block     step-body block index
+      step_vars     step-local per-step input var names   [len = n_seq]
+      seq_vars      their source sequence var names
+      mem_vars      step-local pre-memory var names       [len = n_mem]
+      init_vars     their initial-value var names
+      update_vars   var whose post-step value is the next memory
+      out_vars      step-local output var names
+      stack_vars    parent-level stacked output var names
+      time_major    True: seqs are [T, B, ...] (StaticRNN);
+                    False: [B, T, ...] padded (DynamicRNN)
+      length_var    optional [B] int lengths: steps at-or-past a row's
+                    length freeze its memories and zero its outputs
+    """
+    sub = program.block(op.attrs['sub_block'])
+    a = op.attrs
+    time_major = a.get('time_major', True)
+    seqs = [jnp.asarray(env[n]) for n in a['seq_vars']]
+    if not seqs:
+        raise ValueError('recurrent op needs at least one step_input')
+    xs = [s if time_major else jnp.moveaxis(s, 1, 0) for s in seqs]
+    T = int(xs[0].shape[0])
+    inits = [jnp.asarray(env[n]) for n in a['init_vars']]
+    lengths = None
+    if a.get('length_var'):
+        lengths = jnp.asarray(env[a['length_var']]).reshape(-1)
+
+    step_vars, mem_vars = a['step_vars'], a['mem_vars']
+    update_vars, out_vars = a['update_vars'], a['out_vars']
+
+    def step(carry, t_and_x):
+        t, xts = t_and_x
+        env2 = dict(env)
+        for name, val in zip(step_vars, xts):
+            env2[name] = val
+        for name, val in zip(mem_vars, carry):
+            env2[name] = val
+        _run_block(sub, env2, ectx, program)
+        new = [_coerce_carry(env2[u], m, u)
+               for u, m in zip(update_vars, carry)]
+        outs = [jnp.asarray(env2[o]) for o in out_vars]
+        if lengths is not None:
+            active = t < lengths                       # [B]
+            def msk(val, old):
+                m = active.reshape((-1,) + (1,) * (val.ndim - 1))
+                return jnp.where(m, val, old)
+            new = [msk(nv, m) for nv, m in zip(new, carry)]
+            outs = [msk(o, jnp.zeros_like(o)) for o in outs]
+        return new, outs
+
+    _, stacked = lax.scan(step, inits, (jnp.arange(T), xs))
+    for name, val in zip(a['stack_vars'], stacked):
+        env[name] = val if time_major else jnp.moveaxis(val, 0, 1)
 
 
 # --------------------------------------------------------- conditional

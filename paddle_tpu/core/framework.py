@@ -505,9 +505,12 @@ class Block(object):
             if isinstance(existing, Parameter) and \
                     existing._ivalue is not None:
                 return existing
-        p = Parameter(self, shape=shape, dtype=dtype, name=name, **kw)
         # parameters always live in the global (root) block, like the ref
+        # (and their .block must BE the root block — optimizer passes
+        # append update ops to param.block, which must never be a
+        # control-flow sub-block)
         root = self.program.blocks[0]
+        p = Parameter(root, shape=shape, dtype=dtype, name=name, **kw)
         root.vars[name] = p
         self.program._bump()
         return p

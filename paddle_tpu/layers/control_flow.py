@@ -339,74 +339,425 @@ tensor_layers.assign = _switch_aware_assign
 
 
 class IfElse(object):
-    def __init__(self, cond, name=None):
-        raise NotImplementedError(
-            'IfElse: use branch-free masking (layers.Switch) or build two '
-            'programs; data-dependent subgraph selection does not map to '
-            'one XLA executable')
+    """Row-wise if/else over a [B, 1] boolean condition.
 
-
-class StaticRNN(object):
-    """Unrolled RNN over a fixed number of steps (ref StaticRNN).
-
-    TPU-native: memories are python-tracked; step ops append normally and
-    the unroll happens at graph level (XLA fuses the unrolled steps).
+    Parity: reference control_flow.py:1265 (split_lod_tensor → branch
+    bodies → merge_lod_tensor).  TPU-native lowering: there is no
+    data-dependent row compaction — both branch bodies run on the FULL
+    batch and `merge_lod_tensor` select-masks rows back together, which
+    is exactly what XLA wants (static shapes, fused select).  Identical
+    results for row-wise branch bodies; a branch body that reduces over
+    the batch axis would see all rows, unlike the reference (document’d
+    divergence).
     """
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
 
-    def __init__(self, name=None):
-        self.helper = LayerHelper('static_rnn', name=name)
-        self._mems = []  # (mem_var_current, init)
-        self._outputs = []
-        self._seq_len = None
-        self._step_idx = None
-        self._in_rnn = False
-        self._step_inputs = []
-        self._mem_map = {}
+    def __init__(self, cond, name=None):
+        if not isinstance(cond, Variable):
+            raise TypeError('IfElse cond must be a Variable')
+        self.helper = LayerHelper('ifelse', name=name)
+        self.cond = cond
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.input_table = {}
+        self.output_table = ([], [])   # (false_outs, true_outs)
 
-    def step(self):
+    def _block(self, is_true):
         import contextlib
 
         @contextlib.contextmanager
         def cm():
-            self._in_rnn = True
-            yield
-            self._in_rnn = False
+            self.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if is_true
+                           else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+            try:
+                yield
+            finally:
+                self.status = IfElse.OUT_IF_ELSE_BLOCKS
         return cm()
 
+    def true_block(self):
+        return self._block(True)
+
+    def false_block(self):
+        return self._block(False)
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError('IfElse.input must be called inside '
+                             'true_block/false_block')
+        if id(x) not in self.input_table:
+            helper = LayerHelper('ifelse_input')
+            out_true = helper.create_variable_for_type_inference(x.dtype)
+            out_false = helper.create_variable_for_type_inference(x.dtype)
+            helper.append_op(
+                type='split_lod_tensor',
+                inputs={'X': x, 'Mask': self.cond},
+                outputs={'OutTrue': out_true, 'OutFalse': out_false},
+                attrs={'level': 0})
+            self.input_table[id(x)] = (out_true, out_false)
+        out_true, out_false = self.input_table[id(x)]
+        return (out_true if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                else out_false)
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError('IfElse.output must be called inside '
+                             'true_block/false_block')
+        table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        for o in outs:
+            if not isinstance(o, Variable):
+                raise TypeError('each IfElse output must be a Variable')
+            table.append(o)
+
+    def __call__(self):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError('IfElse() must be called outside the blocks')
+        false_outs, true_outs = self.output_table
+        if not false_outs and not true_outs:
+            raise ValueError('IfElse has no outputs')
+        if not false_outs or not true_outs:
+            # single-branch: the reference returns just that branch's
+            # compacted rows; compaction is a dynamic shape, so here the
+            # un-selected rows are ZEROED instead (row order preserved)
+            only = list(true_outs or false_outs)
+            masked = []
+            for o in only:
+                helper = LayerHelper('ifelse_mask')
+                zero = helper.create_variable_for_type_inference(o.dtype)
+                helper.append_op(type='fill_zeros_like',
+                                 inputs={'X': o}, outputs={'Out': zero},
+                                 attrs={})
+                out = helper.create_variable_for_type_inference(o.dtype)
+                t, f = (o, zero) if true_outs else (zero, o)
+                helper.append_op(
+                    type='merge_lod_tensor',
+                    inputs={'InTrue': t, 'InFalse': f, 'Mask': self.cond,
+                            'X': self.cond},
+                    outputs={'Out': out}, attrs={'level': 0})
+                masked.append(out)
+            return masked
+        if len(false_outs) != len(true_outs):
+            raise ValueError('true/false blocks must produce the same '
+                             'number of outputs')
+        merged = []
+        for f, t in zip(false_outs, true_outs):
+            helper = LayerHelper('ifelse_merge')
+            out = helper.create_variable_for_type_inference(t.dtype)
+            helper.append_op(
+                type='merge_lod_tensor',
+                inputs={'InTrue': t, 'InFalse': f, 'Mask': self.cond,
+                        'X': self.cond},
+                outputs={'Out': out}, attrs={'level': 0})
+            merged.append(out)
+        return merged
+
+
+class _MemoryLink(object):
+    def __init__(self, init, pre_mem):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = None          # set by update_memory
+
+
+class _RecurrentBase(object):
+    """Shared builder for StaticRNN / DynamicRNN: collects the step block
+    and appends ONE `recurrent` op lowered to lax.scan
+    (core/control_flow_exec._exec_recurrent)."""
+
+    _time_major = True
+
+    def __init__(self, name=None, kind='static_rnn'):
+        self.helper = LayerHelper(kind, name=name)
+        self.memories = {}       # pre_mem name -> _MemoryLink (ordered)
+        self.inputs = []         # (step_var, seq_source_var)
+        self.outputs = []        # parent-level stacked vars
+        self._step_outs = []
+        self.seq_len = None
+        self._sub = None
+        self._parent = None
+        self._done = False
+
+    # -- block management
+    def _enter(self):
+        prog = default_main_program()
+        self._parent = prog.current_block()
+        self._sub = prog._create_block()
+
+    def _exit(self):
+        default_main_program()._rollback()
+        self._complete()
+        self._done = True
+
+    def _guard(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self._enter()
+            try:
+                yield
+            except BaseException:
+                # body failed: leave the sub-block but do NOT append the
+                # recurrent op — a half-built step block must not survive
+                # in the program (and _complete's own errors must not
+                # mask the user's)
+                default_main_program()._rollback()
+                self._done = True
+                raise
+            self._exit()
+        return cm()
+
+    def _assert_in_block(self, method):
+        if self._sub is None or self._done:
+            raise ValueError('%s must be called inside the rnn block'
+                             % method)
+
+    def update_memory(self, mem, var):
+        self._assert_in_block('update_memory')
+        if not isinstance(mem, Variable) or not isinstance(var, Variable):
+            raise TypeError('update_memory takes (pre_mem, new) Variables')
+        if mem.name not in self.memories:
+            raise ValueError('%s is not a memory created by memory()'
+                             % mem.name)
+        self.memories[mem.name].mem = var
+
+    def _make_memory(self, init):
+        from ..core import unique_name
+        pre = self._sub.create_var(
+            name=unique_name.generate(self.helper.name + '_mem'),
+            dtype=init.dtype,
+            shape=tuple(init.shape) if init.shape is not None else None)
+        self.memories[pre.name] = _MemoryLink(init, pre)
+        return pre
+
+    def _complete(self):
+        if not self.inputs:
+            raise ValueError('rnn block needs at least one step_input')
+        links = list(self.memories.values())
+        attrs = {
+            'sub_block': self._sub.idx,
+            'step_vars': [sv.name for sv, _ in self.inputs],
+            'seq_vars': [src.name for _, src in self.inputs],
+            'mem_vars': [ln.pre_mem.name for ln in links],
+            'init_vars': [ln.init.name for ln in links],
+            # a memory never updated carries through unchanged
+            'update_vars': [(ln.mem or ln.pre_mem).name for ln in links],
+            'out_vars': [o.name for o in self._step_outs],
+            'stack_vars': [o.name for o in self.outputs],
+            'time_major': self._time_major,
+            'length_var': self._length_name(),
+        }
+        inputs = {'Seq': [src for _, src in self.inputs],
+                  'Init': [ln.init for ln in links]}
+        lv = self._length_name()
+        if lv:
+            inputs['Length'] = [self._parent._find_var_recursive(lv)]
+        self._parent.append_op(
+            type='recurrent', inputs=inputs,
+            outputs={'Out': list(self.outputs)}, attrs=attrs,
+            infer_shape=False)
+
+    def _length_name(self):
+        return None
+
+    def output(self, *outputs):
+        self._assert_in_block('output')
+        for o in outputs:
+            if not isinstance(o, Variable):
+                raise TypeError('rnn output takes Variables')
+            self._step_outs.append(o)
+            self.outputs.append(self._make_stacked_out(o))
+
+    def __call__(self, *args, **kwargs):
+        if not self._done:
+            raise ValueError('rnn outputs can only be retrieved after the '
+                             'rnn block closes')
+        if not self.outputs:
+            raise ValueError('rnn has no output')
+        return self.outputs[0] if len(self.outputs) == 1 else self.outputs
+
+
+class StaticRNN(_RecurrentBase):
+    """RNN over a statically-known number of time steps.
+
+    Parity: reference control_flow.py:278 (StaticRNN) +
+    operators/recurrent_op.cc.  Sequence inputs are TIME-MAJOR
+    [T, B, ...]; `step_input` yields the [B, ...] slice, `memory`/
+    `update_memory` chain state across steps, `output` stacks per-step
+    values back to [T, B, ...].  Lowered to one `lax.scan` (the
+    reference re-runs the step block T times on the host)."""
+
+    _time_major = True
+
+    def __init__(self, name=None):
+        super(StaticRNN, self).__init__(name=name, kind='static_rnn')
+
+    def step(self):
+        return self._guard()
+
     def step_input(self, x):
-        # x: [B, T, ...] → per-step slices handled by unroll at graph level
-        self._seq_len = x.shape[1]
-        self._step_inputs.append(x)
-        return x
+        self._assert_in_block('step_input')
+        if not isinstance(x, Variable):
+            raise TypeError('step_input takes a Variable')
+        if x.shape is None:
+            raise ValueError('step_input needs a known [T, B, ...] shape')
+        if self.seq_len is None:
+            self.seq_len = int(x.shape[0])
+        elif self.seq_len != int(x.shape[0]):
+            raise ValueError('StaticRNN needs a fixed seq_len; got %s vs %s'
+                             % (x.shape[0], self.seq_len))
+        from ..core import unique_name
+        ipt = self._sub.create_var(
+            name=unique_name.generate(self.helper.name + '_step_in'),
+            dtype=x.dtype, shape=tuple(x.shape[1:]))
+        self.inputs.append((ipt, x))
+        return ipt
 
     def memory(self, init=None, shape=None, batch_ref=None,
                init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._assert_in_block('memory')
         if init is None:
-            init = tensor_layers.fill_constant_batch_size_like(
-                batch_ref, [0] + list(shape), 'float32', init_value)
-        self._mem_map[id(init)] = init
-        return init
+            if shape is None or batch_ref is None:
+                raise ValueError('memory() needs init= or (shape, '
+                                 'batch_ref)')
+            from ..core import unique_name
+            # the boot op lives in the PARENT block (it runs once, before
+            # the scan), but batch_ref is usually the step-local input —
+            # shapes are static here, so resolve the batch dim at build
+            # time and emit a plain fill_constant
+            bs = list(shape)
+            if batch_ref.shape is None or \
+                    batch_ref.shape[ref_batch_dim_idx] in (-1, None):
+                raise ValueError(
+                    'StaticRNN.memory needs a statically-shaped batch_ref '
+                    '(got %s)' % (batch_ref.shape,))
+            bs[init_batch_dim_idx] = int(batch_ref.shape[ref_batch_dim_idx])
+            boot = self._parent.create_var(
+                name=unique_name.generate(self.helper.name + '_boot'),
+                dtype=batch_ref.dtype, shape=tuple(bs))
+            self._parent.append_op(
+                type='fill_constant',
+                inputs={}, outputs={'Out': boot},
+                attrs={'shape': bs, 'value': float(init_value),
+                       'dtype': batch_ref.dtype},
+                infer_shape=False)
+            return self.memory(init=boot)
+        return self._make_memory(init)
 
-    def update_memory(self, mem, var):
-        pass  # graph-level unrolling handles chaining
+    def step_output(self, o):
+        self.output(o)
 
-    def output(self, *outputs):
-        self._outputs.extend(outputs)
+    def _make_stacked_out(self, o):
+        from ..core import unique_name
+        shape = ((self.seq_len,) + tuple(o.shape)
+                 if o.shape is not None else None)
+        return self._parent.create_var(
+            name=unique_name.generate(self.helper.name + '_out'),
+            dtype=o.dtype, shape=shape)
 
-    def __call__(self):
-        return self._outputs if len(self._outputs) > 1 else self._outputs[0]
 
+class DynamicRNN(_RecurrentBase):
+    """RNN over padded variable-length batches.
 
-class DynamicRNN(object):
+    Parity: reference control_flow.py:1395 (DynamicRNN).  The reference
+    sorts sequences by length (rank table) and shrinks the batch as
+    sequences end; here sequences stay in feed order as a padded
+    [B, T, ...] LoDTensor + lengths, and one `lax.scan` runs all T steps
+    with masked carries: a finished row's memory freezes and its outputs
+    are zero past its length.  Same results, static shapes, no row
+    reordering (so `need_reorder` is a no-op by design)."""
+
+    _time_major = False
+
     def __init__(self, name=None):
-        raise NotImplementedError(
-            'DynamicRNN: use dynamic_lstm/dynamic_gru (lax.scan-based) '
-            'layers; arbitrary per-step Python bodies over ragged batches '
-            'do not map to a single XLA loop. See SURVEY.md §2.2.')
+        super(DynamicRNN, self).__init__(name=name, kind='dynamic_rnn')
+        self._lengths_name = None
+
+    def block(self):
+        return self._guard()
+
+    def step_input(self, x, level=0):
+        self._assert_in_block('step_input')
+        if not isinstance(x, Variable):
+            raise TypeError('step_input takes a Variable')
+        if x.shape is None or len(x.shape) < 2:
+            raise ValueError('DynamicRNN step_input needs a padded '
+                             '[B, T, ...] variable')
+        if self._lengths_name is None:
+            lv = nn_layers._len_var(x)
+            if lv is None:
+                raise ValueError(
+                    'DynamicRNN step_input needs sequence lengths: feed a '
+                    'lod_level=1 LoDTensor (its @LENGTH companion rides '
+                    'along) — got plain dense var %s' % x.name)
+            self._lengths_name = lv.name
+        if self.seq_len is None:
+            self.seq_len = int(x.shape[1])
+        from ..core import unique_name
+        ipt = self._sub.create_var(
+            name=unique_name.generate(self.helper.name + '_step_in'),
+            dtype=x.dtype, shape=(x.shape[0],) + tuple(x.shape[2:]))
+        self.inputs.append((ipt, x))
+        return ipt
+
+    def static_input(self, x):
+        """A non-sequence input visible unchanged at every step (the
+        reference reorders it by the rank table; rows here never move)."""
+        self._assert_in_block('static_input')
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype='float32'):
+        self._assert_in_block('memory')
+        if init is not None:
+            return self._make_memory(init)
+        if shape is None:
+            raise ValueError('memory() needs init= or shape=')
+        if not self.inputs:
+            raise ValueError('memory(shape=...) must come after '
+                             'step_input (batch size reference)')
+        from ..core import unique_name
+        ref = self.inputs[0][1]
+        boot = self._parent.create_var(
+            name=unique_name.generate(self.helper.name + '_boot'),
+            dtype=dtype, shape=(ref.shape[0],) + tuple(shape))
+        self._parent.append_op(
+            type='fill_constant_batch_size_like',
+            inputs={'Input': ref}, outputs={'Out': boot},
+            attrs={'shape': [-1] + list(shape), 'value': float(value),
+                   'dtype': dtype, 'input_dim_idx': 0,
+                   'output_dim_idx': 0},
+            infer_shape=False)
+        return self._make_memory(boot)
+
+    def _length_name(self):
+        return self._lengths_name
+
+    def _make_stacked_out(self, o):
+        from ..core import unique_name
+        shape = None
+        if o.shape is not None:
+            shape = (o.shape[0], self.seq_len) + tuple(o.shape[1:])
+        out = self._parent.create_var(
+            name=unique_name.generate(self.helper.name + '_out'),
+            dtype=o.dtype, shape=shape)
+        out.lod_level = 1
+        out.lod_length_name = self._lengths_name
+        return out
 
 
 def reorder_lod_tensor_by_rank(x, rank_table):
-    # padded representation never reorders rows for efficiency
+    """Identity BY DESIGN — read before relying on reference semantics.
+
+    The reference (control_flow.py reorder_lod_tensor_by_rank) physically
+    permutes rows into rank-table order (longest sequence first) because
+    its DynamicRNN shrinks the batch as sequences end.  This framework's
+    padded+lengths layout never reorders rows — DynamicRNN masks finished
+    rows instead — so every consumer sees rows in ORIGINAL feed order.
+    Code that assumes rank-sorted row order after this call will behave
+    differently than under the reference."""
     return x
 
 

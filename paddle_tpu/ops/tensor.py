@@ -379,3 +379,23 @@ def print_op(ctx, ins, attrs):
 @register('is_empty')
 def is_empty_op(ctx, ins, attrs):
     return {'Out': jnp.asarray(ins['X'].size == 0)}
+
+
+@register('split_lod_tensor')
+def split_lod_tensor(ctx, ins, attrs):
+    """IfElse row split (ref operators/split_lod_tensor_op.cc).  The
+    reference compacts rows into two shorter batches; under static-shape
+    XLA both branch bodies run the full batch and merge_lod_tensor picks
+    rows, so the 'split' is a passthrough."""
+    x = ins['X']
+    return {'OutTrue': x, 'OutFalse': x}
+
+
+@register('merge_lod_tensor')
+def merge_lod_tensor(ctx, ins, attrs):
+    """IfElse row merge (ref operators/merge_lod_tensor_op.cc): row i of
+    the output comes from InTrue where Mask[i] else InFalse — one fused
+    select."""
+    t, f, m = ins['InTrue'], ins['InFalse'], ins['Mask']
+    m = m.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
+    return {'Out': jnp.where(m, t, f)}
