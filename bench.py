@@ -27,6 +27,11 @@ import time
 import numpy as np
 
 BASELINE_TOKENS_PER_SEC = 5100.0
+# Fluid-era V100 fp32 ResNet-50 throughput stand-in (BASELINE.json has no
+# published numbers; benchmark/fluid's README-era figure is ~360 img/s)
+BASELINE_RESNET_IMAGES_PER_SEC = 360.0
+# canonical ResNet-50 224x224 forward cost; training ~= 3x forward
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
 PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_TIMEOUT', '300'))
 
 # peak bf16 FLOP/s by TPU generation (public spec sheets)
@@ -101,6 +106,52 @@ def allreduce_bw_gbps(n_iters=10, nbytes=64 * 1024 * 1024):
     # ring allreduce moves 2*(n-1)/n of the buffer per device
     moved = 2 * (len(devs) - 1) / len(devs) * n * 4 * n_iters
     return moved / dt / 1e9
+
+
+def bench_resnet50(on_tpu, device_kind):
+    """ResNet-50 training throughput (BASELINE.json headline metric #1;
+    reference harness: benchmark/fluid/fluid_benchmark.py --model resnet
+    with --data_set imagenet, model at benchmark/fluid/models/resnet.py)."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    B = 64 if on_tpu else 2
+    side = 224 if on_tpu else 32
+    classes = 1000 if on_tpu else 10
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        with fluid.unique_name.guard():
+            out = resnet.build(data_shape=(3, side, side),
+                               class_dim=classes, depth=50, lr=0.1)
+    main_prog.set_amp(True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {'data': rng.rand(B, 3, side, side).astype('float32'),
+            'label': rng.randint(0, classes, (B, 1)).astype('int64')}
+    with fluid.scope_guard(scope):
+        t0 = time.perf_counter()
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main_prog, feed=feed, fetch_list=[out['loss']])
+        print('BENCH: resnet50 compile+warmup ok (%.1fs)'
+              % (time.perf_counter() - t0), file=sys.stderr)
+        steps = 20 if on_tpu else 3
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, = exe.run(main_prog, feed=feed,
+                            fetch_list=[out['loss']])
+        np.asarray(loss)  # block
+        dt = time.perf_counter() - t0
+    ips = steps * B / dt
+    peak = peak_flops(device_kind) if on_tpu else None
+    mfu = (round(RESNET50_TRAIN_FLOPS_PER_IMAGE * ips / peak, 4)
+           if peak else None)
+    return {'resnet50_images_per_sec': round(ips, 1),
+            'resnet50_vs_baseline': round(
+                ips / BASELINE_RESNET_IMAGES_PER_SEC, 3),
+            'resnet50_mfu': mfu, 'resnet50_batch': B}
 
 
 def main():
@@ -207,6 +258,15 @@ def main():
     except Exception as e:  # noqa: BLE001 - diagnostic-only path
         print('BENCH: allreduce microbench failed: %s' % e, file=sys.stderr)
 
+    resnet_rec = {}
+    try:
+        resnet_rec = bench_resnet50(on_tpu, device_kind)
+        print('BENCH: resnet50 ok: %.1f img/s' %
+              resnet_rec['resnet50_images_per_sec'], file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - second metric is best-effort
+        print('BENCH: resnet50 bench failed: %s' % e, file=sys.stderr)
+        resnet_rec = {'resnet50_error': str(e)[:200]}
+
     rec = {
         'metric': 'transformer_base_tokens_per_sec_per_chip',
         'value': round(tps, 1),
@@ -219,6 +279,7 @@ def main():
         'backend': device_kind,
         'batch': B, 'seq': T, 'amp': True, 'flash': True,
     }
+    rec.update(resnet_rec)
     if fallback_reason:
         rec['fallback'] = fallback_reason
     if ar_bw is not None:

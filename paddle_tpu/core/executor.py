@@ -8,6 +8,8 @@ single jitted function, so one `exe.run()` is one device launch.  Parameters
 live on device in a Scope and are donated to the executable, so updates are
 in-place (input/output buffer aliasing) with zero copies.
 """
+import os
+
 import numpy as np
 
 from . import registry
@@ -332,9 +334,19 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
 class Executor(object):
     """Parity: reference executor.py Executor (run/close/feed/fetch API)."""
 
-    def __init__(self, place=None, mesh=None):
+    def __init__(self, place=None, mesh=None, check_nan=None):
         self.place = place if place is not None else TPUPlace(0)
         self.mesh = mesh
+        # nan/inf debug guard (SURVEY §2.8; parity: the reference's global
+        # FLAGS_check_nan_inf, which makes every op kernel assert finite
+        # outputs).  Whole-block lowering has no per-op boundary, so the
+        # check runs on everything that leaves the executable: fetches and
+        # written-back persistables — same detection point a user can act
+        # on, one device->host scalar per array.
+        if check_nan is None:
+            check_nan = os.environ.get('FLAGS_check_nan_inf', '') in (
+                '1', 'true', 'True')
+        self.check_nan = bool(check_nan)
         self._cache = {}
         self._run_counter = {}
         self._shard_targets = {}
@@ -434,14 +446,34 @@ class Executor(object):
         fetches, updates = fn(params,
                               {n: feed_vals[n] for n in feed_names},
                               seed)
+        # write back BEFORE the nan check: params were donated, so the old
+        # scope arrays are dead — raising first would leave the scope
+        # holding deleted buffers right when the user wants to inspect it
         for n, v in updates.items():
             scope.vars[n] = v
+        if self.check_nan:
+            self._assert_finite(itertools.chain(
+                zip(fetch_names, fetches), updates.items()))
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
 
-    def infer_from_program(self, *a, **k):
-        raise NotImplementedError
+    @staticmethod
+    def _assert_finite(named_arrays):
+        import jax.numpy as jnp
+        bad = []
+        for n, v in named_arrays:
+            try:
+                if not bool(jnp.all(jnp.isfinite(v))):
+                    bad.append(n)
+            except TypeError:
+                continue  # non-numeric (e.g. tensor arrays) — skip
+        if bad:
+            raise RuntimeError(
+                'check_nan: non-finite values (nan/inf) detected after this '
+                'step in: %s. Typical causes: exploding gradients (try '
+                'gradient clipping or a lower LR), log/div of zero, or '
+                'uninitialized feeds.' % ', '.join(sorted(bad)))
 
 
 class _CompiledProgramBase(object):

@@ -94,3 +94,38 @@ def test_fetch_param_directly():
     exe.run(fluid.default_startup_program())
     out, = exe.run(fetch_list=['pp'])
     np.testing.assert_allclose(out, [2.5] * 3, rtol=1e-6)
+
+
+def test_check_nan_raises_on_nonfinite_fetch():
+    import pytest
+    x = fluid.layers.data('x', shape=[2], dtype='float32')
+    y = fluid.layers.log(x)          # log(0) = -inf, log(-1) = nan
+    exe = fluid.Executor(check_nan=True)
+    with pytest.raises(RuntimeError, match='non-finite'):
+        exe.run(feed={'x': np.array([[0.0, -1.0]], 'float32')},
+                fetch_list=[y])
+    # finite input passes cleanly through the same executor
+    out, = exe.run(feed={'x': np.array([[1.0, 2.0]], 'float32')},
+                   fetch_list=[y])
+    np.testing.assert_allclose(out, np.log([[1.0, 2.0]]), rtol=1e-6)
+
+
+def test_check_nan_names_poisoned_param_update():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        w = fluid.layers.create_parameter([2, 1], 'float32', name='w_nan')
+        # sqrt'(u) = 1/(2 sqrt(u)) is nan for u<0 — the nan gradient
+        # poisons the updated weight, not just the loss
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.sqrt(fluid.layers.matmul(x, w)))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    exe = fluid.Executor(check_nan=True)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # negative product -> log gives nan -> nan gradient poisons w
+        with pytest.raises(RuntimeError, match='w_nan'):
+            exe.run(main, feed={'x': np.array([[-1.0, -1.0]], 'float32')},
+                    fetch_list=[loss])
