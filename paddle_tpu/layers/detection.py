@@ -268,24 +268,191 @@ def detection_map(detect_res, label, class_num, background_label=0,
     return m
 
 
-def rpn_target_assign(*args, **kwargs):
-    raise NotImplementedError(
-        'rpn_target_assign: RCNN proposal target assignment is host-side '
-        'preprocessing in this framework; see SURVEY.md §2.2')
+def _gt_length_input(ins, gt_boxes):
+    from .nn import _len_var
+    lv = _len_var(gt_boxes)
+    if lv is not None:
+        ins['GtLength'] = lv
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError(
-        'generate_proposals: variable-count proposals are not '
-        'XLA-compatible; use multiclass_nms fixed-size path')
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """RPN anchor target assignment (ref layers/detection.py:55 /
+    operators/detection/rpn_target_assign_op.cc).
+
+    TPU-native: fixed-size outputs — K = rpn_batch_size_per_im score rows
+    and Kf = K*rpn_fg_fraction location rows PER IMAGE (the reference
+    returns ragged gathered rows).  `use_random` subsampling is replaced
+    by deterministic top-K-by-IoU.  Rows that are padding or ignore-zone
+    anchors carry target_label == -1: compute the cls loss with
+    ignore_index=-1 (sigmoid_cross_entropy_with_logits supports it), and
+    bbox_inside_weight zeroes fake location rows — with those masks the
+    losses match the reference's sampled losses.  gt_boxes is the padded
+    [N, G, 4] LoDTensor (lengths ride along).  Returns (predicted_scores,
+    predicted_location, target_label, target_bbox, bbox_inside_weight)
+    like the reference."""
+    from .nn import gather, reshape
+    helper = LayerHelper('rpn_target_assign')
+    N = bbox_pred.shape[0] if bbox_pred.shape else -1
+    K = rpn_batch_size_per_im
+    Kf = max(1, int(K * rpn_fg_fraction))
+    loc_index = helper.create_variable_for_type_inference('int32')
+    score_index = helper.create_variable_for_type_inference('int32')
+    target_label = helper.create_variable_for_type_inference('int32')
+    target_bbox = helper.create_variable_for_type_inference(
+        anchor_box.dtype)
+    inside_w = helper.create_variable_for_type_inference(anchor_box.dtype)
+    score_w = helper.create_variable_for_type_inference('float32')
+    ins = {'Anchor': anchor_box, 'GtBoxes': gt_boxes}
+    if is_crowd is not None:
+        ins['IsCrowd'] = is_crowd
+    if im_info is not None:
+        ins['ImInfo'] = im_info
+    _gt_length_input(ins, gt_boxes)
+    helper.append_op(
+        type='rpn_target_assign', inputs=ins,
+        outputs={'LocationIndex': loc_index, 'ScoreIndex': score_index,
+                 'TargetLabel': target_label, 'TargetBBox': target_bbox,
+                 'BBoxInsideWeight': inside_w, 'ScoreWeight': score_w},
+        attrs={'rpn_batch_size_per_im': rpn_batch_size_per_im,
+               'rpn_straddle_thresh': rpn_straddle_thresh,
+               'rpn_positive_overlap': rpn_positive_overlap,
+               'rpn_negative_overlap': rpn_negative_overlap,
+               'rpn_fg_fraction': rpn_fg_fraction,
+               'use_random': use_random},
+        infer_shape=False)
+    for v, shp in ((loc_index, (N, Kf)), (score_index, (N, K)),
+                   (target_label, (N, K, 1)), (target_bbox, (N, Kf, 4)),
+                   (inside_w, (N, Kf, 4)), (score_w, (N, K, 1))):
+        v.shape = shp
+        v.stop_gradient = True
+    # gather the predictions at the sampled rows, batched
+    pred_scores = _batched_row_gather(cls_logits, score_index, 1)
+    pred_loc = _batched_row_gather(bbox_pred, loc_index, 4)
+    return pred_scores, pred_loc, target_label, target_bbox, inside_w
 
 
-def generate_proposal_labels(*args, **kwargs):
-    raise NotImplementedError('host-side preprocessing; SURVEY.md §2.2')
+def _batched_row_gather(x, idx, feat):
+    """x [N, M, feat], idx [N, K] -> [N, K, feat] via a gather op."""
+    helper = LayerHelper('rcnn_gather')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='batched_gather', inputs={'X': x, 'Index': idx},
+                     outputs={'Out': out}, attrs={}, infer_shape=False)
+    out.shape = (x.shape[0] if x.shape else -1,
+                 idx.shape[1] if idx.shape else -1, feat)
+    return out
 
 
-def generate_mask_labels(*args, **kwargs):
-    raise NotImplementedError('host-side preprocessing; SURVEY.md §2.2')
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """Faster-RCNN proposal generation (ref layers/detection.py:1878 /
+    operators/detection/generate_proposals_op.cc): decode deltas at
+    anchors, clip to image, drop tiny boxes, NMS.  Fixed-size output
+    [N, post_nms_top_n, 4] + probs (invalid rows prob 0) instead of the
+    reference's ragged LoD rois."""
+    helper = LayerHelper('generate_proposals')
+    rois = helper.create_variable_for_type_inference(bbox_deltas.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(
+        type='generate_proposals',
+        inputs={'Scores': scores, 'BboxDeltas': bbox_deltas,
+                'ImInfo': im_info, 'Anchors': anchors,
+                'Variances': variances},
+        outputs={'RpnRois': rois, 'RpnRoiProbs': probs},
+        attrs={'pre_nms_topN': pre_nms_top_n,
+               'post_nms_topN': post_nms_top_n,
+               'nms_thresh': nms_thresh, 'min_size': min_size,
+               'eta': eta},
+        infer_shape=False)
+    N = scores.shape[0] if scores.shape else -1
+    rois.shape = (N, post_nms_top_n, 4)
+    probs.shape = (N, post_nms_top_n, 1)
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True):
+    """Fast-RCNN RoI targets (ref layers/detection.py:1649 /
+    generate_proposal_labels_op.cc): label proposals by best-IoU gt,
+    fixed batch_size_per_im rows per image with class-slotted bbox
+    targets; deterministic top-K stands in for host RNG sampling."""
+    helper = LayerHelper('generate_proposal_labels')
+    class_nums = class_nums or 81
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference('int32')
+    tgt = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    in_w = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    out_w = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    ins = {'RpnRois': rpn_rois, 'GtClasses': gt_classes,
+           'GtBoxes': gt_boxes}
+    if is_crowd is not None:
+        ins['IsCrowd'] = is_crowd
+    if im_info is not None:
+        ins['ImInfo'] = im_info
+    _gt_length_input(ins, gt_boxes)
+    helper.append_op(
+        type='generate_proposal_labels', inputs=ins,
+        outputs={'Rois': rois, 'LabelsInt32': labels, 'BboxTargets': tgt,
+                 'BboxInsideWeights': in_w, 'BboxOutsideWeights': out_w},
+        attrs={'batch_size_per_im': batch_size_per_im,
+               'fg_fraction': fg_fraction, 'fg_thresh': fg_thresh,
+               'bg_thresh_hi': bg_thresh_hi, 'bg_thresh_lo': bg_thresh_lo,
+               'bbox_reg_weights': list(bbox_reg_weights),
+               'class_nums': class_nums, 'use_random': use_random},
+        infer_shape=False)
+    N = rpn_rois.shape[0] if rpn_rois.shape else -1
+    B = batch_size_per_im
+    for v, shp in ((rois, (N, B, 4)), (labels, (N, B, 1)),
+                   (tgt, (N, B, 4 * class_nums)),
+                   (in_w, (N, B, 4 * class_nums)),
+                   (out_w, (N, B, 4 * class_nums))):
+        v.shape = shp
+        v.stop_gradient = True
+    return rois, labels, tgt, in_w, out_w
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         roi_gt_index=None):
+    """Mask-RCNN mask targets (ref layers/detection.py:1744 /
+    generate_mask_labels_op.cc).  gt_segms is ONE padded polygon per
+    instance [N, G, P, 2] (the reference accepts multi-polygon LoD);
+    rasterization is a vectorized even-odd crossing test on the
+    resolution grid.  `roi_gt_index` [N, B, 1] maps each roi to its gt
+    (as produced alongside generate_proposal_labels)."""
+    helper = LayerHelper('generate_mask_labels')
+    if roi_gt_index is None:
+        raise ValueError('generate_mask_labels needs roi_gt_index '
+                         '(matched gt per roi)')
+    mask_rois = helper.create_variable_for_type_inference(rois.dtype)
+    has_mask = helper.create_variable_for_type_inference('int32')
+    mask = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='generate_mask_labels',
+        inputs={'Rois': rois, 'LabelsInt32': labels_int32,
+                'GtSegms': gt_segms, 'RoiGtIndex': roi_gt_index},
+        outputs={'MaskRois': mask_rois, 'RoiHasMaskInt32': has_mask,
+                 'MaskInt32': mask},
+        attrs={'num_classes': num_classes, 'resolution': resolution},
+        infer_shape=False)
+    N = rois.shape[0] if rois.shape else -1
+    B = rois.shape[1] if rois.shape else -1
+    mask_rois.shape = (N, B, 4)
+    has_mask.shape = (N, B, 1)
+    mask.shape = (N, B, num_classes * resolution * resolution)
+    for v in (mask_rois, has_mask, mask):
+        v.stop_gradient = True
+    return mask_rois, has_mask, mask
 
 
 def roi_perspective_transform(input, rois, transformed_height,

@@ -665,3 +665,302 @@ def detection_map(ctx, ins, attrs):
                      jnp.where(have, aps, 0.0).sum() /
                      jnp.maximum(have.sum(), 1), 0.0)
     return {'MAP': m_ap.reshape(1).astype(jnp.float32)}
+
+
+# ------------------------------------------------------ RCNN family
+# Parity: reference operators/detection/{rpn_target_assign_op.cc,
+# generate_proposals_op.cc, generate_proposal_labels_op.cc,
+# generate_mask_labels_op.cc}.  The reference emits variable-count LoD
+# outputs and samples rows with host RNG; here every output is FIXED-K
+# per image with validity weights (invalid rows carry zero weight), and
+# "sampling" is deterministic top-K by overlap — same training losses
+# once the weights mask the padding, and the whole pipeline stays in one
+# XLA executable.
+
+def _iou_matrix(a, b):
+    """a [M,4], b [G,4] xyxy -> [M,G]."""
+    def area(x):
+        return jnp.maximum(x[..., 2] - x[..., 0], 0) * \
+            jnp.maximum(x[..., 3] - x[..., 1], 0)
+    xi = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    yi = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    xa = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    ya = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(xa - xi, 0) * jnp.maximum(ya - yi, 0)
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _encode_deltas(anchors, gt, weights=(1.0, 1.0, 1.0, 1.0)):
+    """Standard RCNN box-delta encoding of gt wrt anchors [K,4]->[K,4]."""
+    aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-6)
+    ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-6)
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-6)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-6)
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    wx, wy, ww, wh = weights
+    return jnp.stack([(gcx - acx) / aw / wx, (gcy - acy) / ah / wy,
+                      jnp.log(gw / aw) / ww, jnp.log(gh / ah) / wh],
+                     axis=1)
+
+
+@register('generate_proposals')
+def generate_proposals(ctx, ins, attrs):
+    """Decode RPN deltas at anchors, clip, min-size filter, NMS.
+    Outputs are fixed [N, post_nms_topN, 4] rois + [N, post_nms_topN, 1]
+    probs (invalid rows prob 0) instead of the reference's ragged LoD."""
+    scores = ins['Scores']            # [N, A, H, W]
+    deltas = ins['BboxDeltas']        # [N, 4A, H, W]
+    im_info = ins['ImInfo']           # [N, 3] (h, w, scale)
+    anchors = ins['Anchors'].reshape(-1, 4)     # [H*W*A, 4]
+    variances = ins['Variances'].reshape(-1, 4)
+    pre_n = int(attrs.get('pre_nms_topN', 6000))
+    post_n = int(attrs.get('post_nms_topN', 1000))
+    nms_thresh = float(attrs.get('nms_thresh', 0.5))
+    min_size = float(attrs.get('min_size', 0.1))
+    N, A, H, W = scores.shape
+
+    def per_image(sc, dl, info):
+        # -> anchor-major [H, W, A(,4)] to line up with the anchor layout
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)          # [HWA]
+        d = jnp.transpose(dl.reshape(A, 4, H, W),
+                          (2, 3, 0, 1)).reshape(-1, 4)        # [HWA, 4]
+        k1 = min(pre_n, s.shape[0])
+        top_s, top_i = jax.lax.top_k(s, k1)
+        top_d = jnp.take(d, top_i, axis=0)
+        top_a = jnp.take(anchors, top_i, axis=0)
+        top_v = jnp.take(variances, top_i, axis=0)
+        # decode (center-size with per-anchor variances)
+        aw = top_a[:, 2] - top_a[:, 0]
+        ah = top_a[:, 3] - top_a[:, 1]
+        acx = top_a[:, 0] + 0.5 * aw
+        acy = top_a[:, 1] + 0.5 * ah
+        cx = top_d[:, 0] * top_v[:, 0] * aw + acx
+        cy = top_d[:, 1] * top_v[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(top_d[:, 2] * top_v[:, 2], 10.0)) * aw
+        h = jnp.exp(jnp.minimum(top_d[:, 3] * top_v[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=1)
+        # clip to image
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
+        # drop tiny boxes (min_size scaled to the input image)
+        ms = min_size * info[2]
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms) &
+                   (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        top_s = jnp.where(keep_sz, top_s, -jnp.inf)
+        k2 = min(post_n, k1)
+        keep = _nms_fixed(boxes, top_s, nms_thresh, k2)
+        rois = jnp.take(boxes, keep, axis=0)
+        probs = jnp.take(top_s, keep)
+        valid = jnp.isfinite(probs)
+        rois = jnp.where(valid[:, None], rois, 0.0)
+        probs = jnp.where(valid, probs, 0.0)
+        if k2 < post_n:
+            rois = jnp.pad(rois, ((0, post_n - k2), (0, 0)))
+            probs = jnp.pad(probs, (0, post_n - k2))
+        return rois, probs[:, None]
+
+    rois, probs = jax.vmap(per_image)(scores, deltas, im_info)
+    return {'RpnRois': rois, 'RpnRoiProbs': probs}
+
+
+@register('rpn_target_assign')
+def rpn_target_assign(ctx, ins, attrs):
+    """Anchor-side RPN targets.  Fixed-size per image: K sampled score
+    rows (fg+bg) and Kf location rows; deterministic top-K-by-IoU
+    subsampling stands in for the reference's host RNG sampling."""
+    anchor = ins['Anchor']            # [M, 4]
+    gt = ins['GtBoxes']               # [N, G, 4] padded
+    gt_len = ins.get('GtLength')      # [N] valid gt counts
+    is_crowd = ins.get('IsCrowd')     # [N, G] (1 = crowd, excluded)
+    K = int(attrs.get('rpn_batch_size_per_im', 256))
+    fg_frac = float(attrs.get('rpn_fg_fraction', 0.5))
+    pos_th = float(attrs.get('rpn_positive_overlap', 0.7))
+    neg_th = float(attrs.get('rpn_negative_overlap', 0.3))
+    Kf = max(1, int(K * fg_frac))
+    N, G = gt.shape[0], gt.shape[1]
+    M = anchor.shape[0]
+    if gt_len is None:
+        gt_len = jnp.full((N,), G, jnp.int32)
+    gt_len = gt_len.reshape(-1).astype(jnp.int32)
+
+    def per_image(g, glen, crowd):
+        valid_g = jnp.arange(G) < glen
+        if crowd is not None:
+            valid_g = valid_g & (crowd.reshape(-1) == 0)
+        iou = _iou_matrix(anchor, g)                  # [M, G]
+        iou = jnp.where(valid_g[None, :], iou, -1.0)
+        best_g = jnp.argmax(iou, axis=1)              # [M]
+        best_iou = jnp.max(iou, axis=1)
+        # (i) the best anchor for each gt is fg.  scatter-MAX: padded gt
+        # columns all argmax to anchor 0, and a duplicate-index set()
+        # applies in undefined order — a pad's False must never erase a
+        # valid gt's True
+        best_a_per_g = jnp.argmax(iou, axis=0)        # [G]
+        forced = jnp.zeros((M,), jnp.int32).at[best_a_per_g].max(
+            valid_g.astype(jnp.int32)) > 0
+        fg = forced | (best_iou >= pos_th)
+        bg = (~fg) & (best_iou < neg_th) & (best_iou >= 0)
+        # deterministic subsample: fg by IoU desc, bg by IoU asc
+        fg_rank = jnp.where(fg, best_iou + forced, -jnp.inf)
+        _, fg_idx = jax.lax.top_k(fg_rank, Kf)
+        fg_ok = jnp.take(fg, fg_idx)
+        bg_rank = jnp.where(bg, -best_iou, -jnp.inf)
+        _, bg_idx = jax.lax.top_k(bg_rank, K - Kf)
+        bg_ok = jnp.take(bg, bg_idx)
+        score_idx = jnp.concatenate([fg_idx, bg_idx])
+        score_w = jnp.concatenate([fg_ok, bg_ok]).astype(jnp.float32)
+        labels = jnp.concatenate([jnp.ones((Kf,), jnp.int32),
+                                  jnp.zeros((K - Kf,), jnp.int32)])
+        # rows that are padding / ignore-zone anchors get label -1 so a
+        # loss with ignore_index=-1 skips them (score_w carries the same
+        # mask as a float weight)
+        labels = jnp.where(score_w > 0, labels, -1)
+        # location targets for the fg rows
+        tgt_g = jnp.take(best_g, fg_idx)              # [Kf]
+        tgt_boxes = jnp.take(g, tgt_g, axis=0)
+        loc_anchor = jnp.take(anchor, fg_idx, axis=0)
+        tgt = _encode_deltas(loc_anchor, tgt_boxes)
+        inside_w = jnp.where(fg_ok[:, None], 1.0, 0.0) * \
+            jnp.ones((Kf, 4), jnp.float32)
+        tgt = tgt * inside_w
+        return (fg_idx.astype(jnp.int32), score_idx.astype(jnp.int32),
+                labels[:, None], tgt, inside_w, score_w[:, None])
+
+    (loc_i, score_i, labels, tgt_bbox, inside_w, score_w) = jax.vmap(
+        per_image)(gt, gt_len,
+                   is_crowd if is_crowd is not None else
+                   jnp.zeros((N, G), jnp.int32))
+    return {'LocationIndex': loc_i, 'ScoreIndex': score_i,
+            'TargetLabel': labels, 'TargetBBox': tgt_bbox,
+            'BBoxInsideWeight': inside_w, 'ScoreWeight': score_w}
+
+
+@register('generate_proposal_labels')
+def generate_proposal_labels(ctx, ins, attrs):
+    """RoI-side Fast-RCNN targets: label each proposal by best-IoU gt,
+    fixed B = batch_size_per_im sampled rows per image."""
+    rois = ins['RpnRois']             # [N, R, 4]
+    gt_cls = ins['GtClasses']         # [N, G, 1] int
+    gt = ins['GtBoxes']               # [N, G, 4]
+    gt_len = ins.get('GtLength')
+    is_crowd = ins.get('IsCrowd')
+    B = int(attrs.get('batch_size_per_im', 256))
+    fg_frac = float(attrs.get('fg_fraction', 0.25))
+    fg_th = float(attrs.get('fg_thresh', 0.5))
+    bg_hi = float(attrs.get('bg_thresh_hi', 0.5))
+    bg_lo = float(attrs.get('bg_thresh_lo', 0.0))
+    bbox_w = attrs.get('bbox_reg_weights', [0.1, 0.1, 0.2, 0.2])
+    n_cls = int(attrs.get('class_nums', 81))
+    Bf = max(1, int(B * fg_frac))
+    N, R = rois.shape[0], rois.shape[1]
+    G = gt.shape[1]
+    if gt_len is None:
+        gt_len = jnp.full((N,), G, jnp.int32)
+    gt_len = gt_len.reshape(-1).astype(jnp.int32)
+
+    def per_image(r, g, gc, glen, crowd):
+        valid_g = jnp.arange(G) < glen
+        if crowd is not None:
+            valid_g = valid_g & (crowd.reshape(-1) == 0)
+        # gt boxes join the roi pool (reference appends them): each valid
+        # gt matches itself at IoU 1, so fg rows exist even when every
+        # RPN proposal is poor (early training bootstrap)
+        r = jnp.concatenate([r, jnp.where(valid_g[:, None], g, 0.0)])
+        iou = _iou_matrix(r, g)
+        iou = jnp.where(valid_g[None, :], iou, -1.0)
+        best_g = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        fg = best_iou >= fg_th
+        bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+        fg_rank = jnp.where(fg, best_iou, -jnp.inf)
+        _, fg_idx = jax.lax.top_k(fg_rank, Bf)
+        fg_ok = jnp.take(fg, fg_idx)
+        bg_rank = jnp.where(bg & ~fg, best_iou, -jnp.inf)
+        _, bg_idx = jax.lax.top_k(bg_rank, B - Bf)
+        bg_ok = jnp.take(bg, bg_idx)
+        sel = jnp.concatenate([fg_idx, bg_idx])
+        ok = jnp.concatenate([fg_ok, bg_ok])
+        out_rois = jnp.take(r, sel, axis=0) * ok[:, None]
+        sel_g = jnp.take(best_g, sel)
+        cls = jnp.take(gc.reshape(-1), sel_g)
+        is_fg = jnp.concatenate([fg_ok, jnp.zeros((B - Bf,), bool)])
+        labels = jnp.where(is_fg, cls, 0).astype(jnp.int32)
+        labels = jnp.where(ok, labels, -1)
+        # class-slotted bbox targets (4*n_cls, filled at the label slot)
+        deltas = _encode_deltas(jnp.take(r, sel, axis=0),
+                                jnp.take(g, sel_g, axis=0),
+                                weights=tuple(bbox_w))
+        onehot = (jnp.arange(n_cls)[None, :] ==
+                  jnp.maximum(labels, 0)[:, None]) & is_fg[:, None]
+        tgt = (onehot[:, :, None] * deltas[:, None, :]).reshape(B,
+                                                                4 * n_cls)
+        in_w = (onehot[:, :, None] *
+                jnp.ones((1, 1, 4))).reshape(B, 4 * n_cls)
+        return (out_rois, labels[:, None], tgt, in_w, in_w)
+
+    (rois_o, labels, tgt, in_w, out_w) = jax.vmap(per_image)(
+        rois, gt, gt_cls, gt_len,
+        is_crowd if is_crowd is not None else
+        jnp.zeros((N, G), jnp.int32))
+    return {'Rois': rois_o, 'LabelsInt32': labels, 'BboxTargets': tgt,
+            'BboxInsideWeights': in_w, 'BboxOutsideWeights': out_w}
+
+
+@register('generate_mask_labels')
+def generate_mask_labels(ctx, ins, attrs):
+    """Mask-RCNN mask targets by polygon rasterization.  gt_segms here is
+    ONE padded polygon per gt instance [N, G, P, 2] (the reference takes
+    multi-polygon LoD); rasterization is an even-odd crossing test over
+    the resolution grid — fully vectorized, no host loop."""
+    rois = ins['Rois']                # [N, B, 4]
+    labels = ins['LabelsInt32']       # [N, B, 1]
+    segms = ins['GtSegms']            # [N, G, P, 2] polygon vertices
+    roi_gt = ins['RoiGtIndex']        # [N, B, 1] matched gt per roi
+    num_cls = int(attrs.get('num_classes', 81))
+    R = int(attrs.get('resolution', 14))
+    N, B = rois.shape[0], rois.shape[1]
+    P = segms.shape[2]
+
+    def rasterize(poly, box):
+        # sample centers of an RxR grid over the roi box
+        x0, y0, x1, y1 = box[0], box[1], box[2], box[3]
+        xs = x0 + (jnp.arange(R) + 0.5) / R * jnp.maximum(x1 - x0, 1e-6)
+        ys = y0 + (jnp.arange(R) + 0.5) / R * jnp.maximum(y1 - y0, 1e-6)
+        gx, gy = jnp.meshgrid(xs, ys, indexing='xy')      # [R, R]
+        px, py = poly[:, 0], poly[:, 1]
+        qx, qy = jnp.roll(px, -1), jnp.roll(py, -1)
+        # even-odd rule: count edges crossing the upward ray from (gx,gy)
+        gxe = gx[..., None]
+        gye = gy[..., None]
+        cond = (py[None, None, :] > gye) != (qy[None, None, :] > gye)
+        t = (gye - py) / jnp.where(qy - py == 0, 1e-12, qy - py)
+        xint = px + t * (qx - px)
+        crossings = jnp.sum(cond & (gxe < xint), axis=-1)
+        return (crossings % 2).astype(jnp.int32)          # [R, R]
+
+    def per_image(r, lab, sg, rg):
+        def per_roi(box, l, gi):
+            poly = sg[jnp.maximum(gi, 0)]
+            m = rasterize(poly, box)
+            has = (l > 0) & (gi >= 0)
+            m = jnp.where(has, m, -1)                     # ignore rows
+            slot = (jnp.arange(num_cls)[:, None, None] ==
+                    jnp.maximum(l, 0))
+            full = jnp.where(slot, m[None], -1)
+            return full.reshape(num_cls * R * R), has.astype(jnp.int32)
+        masks, has = jax.vmap(per_roi)(r, lab.reshape(-1),
+                                       rg.reshape(-1))
+        return r * (has > 0)[:, None].astype(r.dtype), has[:, None], masks
+
+    mask_rois, has_mask, masks = jax.vmap(per_image)(
+        rois, labels, segms, roi_gt)
+    return {'MaskRois': mask_rois, 'RoiHasMaskInt32': has_mask,
+            'MaskInt32': masks}

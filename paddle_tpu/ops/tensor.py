@@ -399,3 +399,13 @@ def merge_lod_tensor(ctx, ins, attrs):
     t, f, m = ins['InTrue'], ins['InFalse'], ins['Mask']
     m = m.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
     return {'Out': jnp.where(m, t, f)}
+
+
+@register('batched_gather')
+def batched_gather(ctx, ins, attrs):
+    """Per-row gather: X [N, M, ...], Index [N, K] -> [N, K, ...]
+    (rows of Index select rows of the matching batch element)."""
+    x, idx = ins['X'], ins['Index']
+    return {'Out': jnp.take_along_axis(
+        x, idx.astype(jnp.int32).reshape(idx.shape[0], idx.shape[1],
+                                         *([1] * (x.ndim - 2))), axis=1)}

@@ -1,0 +1,189 @@
+"""RCNN op family: generate_proposals / rpn_target_assign /
+generate_proposal_labels / generate_mask_labels.
+
+Model: reference tests/unittests/test_generate_proposals_op.py,
+test_rpn_target_assign_op.py, test_generate_proposal_labels_op.py —
+numeric checks against independent numpy implementations of the
+fixed-K semantics.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def _np_iou(a, b):
+    xi = np.maximum(a[:, None, 0], b[None, :, 0])
+    yi = np.maximum(a[:, None, 1], b[None, :, 1])
+    xa = np.minimum(a[:, None, 2], b[None, :, 2])
+    ya = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.maximum(xa - xi, 0) * np.maximum(ya - yi, 0)
+    ar = lambda x: np.maximum(x[:, 2] - x[:, 0], 0) * \
+        np.maximum(x[:, 3] - x[:, 1], 0)
+    return inter / np.maximum(ar(a)[:, None] + ar(b)[None] - inter, 1e-10)
+
+
+def test_generate_proposals_decode_clip_nms():
+    rng = np.random.RandomState(0)
+    N, A, H, W = 2, 3, 4, 4
+    post_n = 8
+    scores = rng.rand(N, A, H, W).astype('float32')
+    deltas = (rng.rand(N, 4 * A, H, W).astype('float32') - 0.5) * 0.4
+    im_info = np.array([[60, 60, 1.0], [60, 60, 1.0]], 'float32')
+    # anchors [H, W, A, 4]
+    base = np.array([8.0, 16.0, 32.0])
+    ys, xs = np.meshgrid(np.arange(H) * 16, np.arange(W) * 16,
+                         indexing='ij')
+    anchors = np.zeros((H, W, A, 4), 'float32')
+    for a, s in enumerate(base):
+        anchors[..., a, 0] = xs - s / 2
+        anchors[..., a, 1] = ys - s / 2
+        anchors[..., a, 2] = xs + s / 2
+        anchors[..., a, 3] = ys + s / 2
+    variances = np.ones((H, W, A, 4), 'float32')
+
+    sc = fluid.layers.data('sc', shape=[A, H, W], dtype='float32')
+    dl = fluid.layers.data('dl', shape=[4 * A, H, W], dtype='float32')
+    ii = fluid.layers.data('ii', shape=[3], dtype='float32')
+    an = fluid.layers.data('an', shape=[H, W, A, 4], dtype='float32',
+                           append_batch_size=False)
+    va = fluid.layers.data('va', shape=[H, W, A, 4], dtype='float32',
+                           append_batch_size=False)
+    rois, probs = layers.generate_proposals(
+        sc, dl, ii, an, va, pre_nms_top_n=20, post_nms_top_n=post_n,
+        nms_thresh=0.7, min_size=4.0)
+    exe = fluid.Executor()
+    rv, pv = exe.run(feed={'sc': scores, 'dl': deltas, 'ii': im_info,
+                           'an': anchors, 'va': variances},
+                     fetch_list=[rois, probs])
+    rv, pv = np.asarray(rv), np.asarray(pv)
+    assert rv.shape == (N, post_n, 4)
+    assert pv.shape == (N, post_n, 1)
+    # probs sorted desc within each image, boxes inside the image
+    for i in range(N):
+        p = pv[i, :, 0]
+        valid = p > 0
+        assert valid.any()
+        assert (np.diff(p[valid]) <= 1e-6).all()
+        b = rv[i][valid]
+        assert (b[:, 0] >= 0).all() and (b[:, 2] <= 59.0 + 1e-4).all()
+        assert (b[:, 2] >= b[:, 0]).all() and (b[:, 3] >= b[:, 1]).all()
+        # surviving pairs respect the NMS threshold
+        iou = _np_iou(b, b)
+        np.fill_diagonal(iou, 0)
+        assert (iou <= 0.7 + 1e-5).all()
+
+
+def test_rpn_target_assign_labels_and_targets():
+    rng = np.random.RandomState(1)
+    M = 24
+    K, Kf = 8, 4
+    anchors = np.zeros((M, 4), 'float32')
+    anchors[:, 0] = rng.rand(M) * 40
+    anchors[:, 1] = rng.rand(M) * 40
+    anchors[:, 2] = anchors[:, 0] + 8 + rng.rand(M) * 8
+    anchors[:, 3] = anchors[:, 1] + 8 + rng.rand(M) * 8
+    # one gt right on top of anchor 5, another overlapping anchor 11
+    gts = [np.stack([anchors[5] + 0.5, anchors[11] + 1.0]),
+           np.stack([anchors[2] + 0.2])]
+    gt_lod = create_lod_tensor([g.astype('float32') for g in gts])
+
+    bp = fluid.layers.data('bp', shape=[M, 4], dtype='float32')
+    cl = fluid.layers.data('cl', shape=[M, 1], dtype='float32')
+    an = fluid.layers.data('an', shape=[M, 4], dtype='float32',
+                           append_batch_size=False)
+    av = fluid.layers.data('av', shape=[M, 4], dtype='float32',
+                           append_batch_size=False)
+    gt = fluid.layers.data('gt', shape=[4], dtype='float32', lod_level=1)
+    outs = layers.rpn_target_assign(
+        bp, cl, an, av, gt, rpn_batch_size_per_im=K, rpn_fg_fraction=0.5,
+        rpn_positive_overlap=0.6, rpn_negative_overlap=0.3)
+    pred_scores, pred_loc, tgt_label, tgt_bbox, inside_w = outs
+    exe = fluid.Executor()
+    rng2 = np.random.RandomState(2)
+    feed = {'bp': rng2.rand(2, M, 4).astype('float32'),
+            'cl': rng2.rand(2, M, 1).astype('float32'),
+            'an': anchors, 'av': np.ones((M, 4), 'float32'),
+            'gt': gt_lod}
+    ps, pl, tl, tb, iw = [np.asarray(v) for v in exe.run(
+        feed=feed, fetch_list=list(outs))]
+    assert ps.shape == (2, K, 1) and pl.shape == (2, Kf, 4)
+    assert tl.shape == (2, K, 1) and tb.shape == (2, Kf, 4)
+    # image 0: anchors 5 and 11 overlap gts strongly -> fg labels first;
+    # padding/ignore-zone rows carry label -1
+    assert tl[0, 0, 0] == 1 and (tl[0] == 1).sum() >= 2
+    assert set(np.unique(tl)) <= {-1, 0, 1}
+    # fg rows with weight 1 have finite encoded targets
+    assert np.isfinite(tb).all()
+    assert set(np.unique(iw)) <= {0.0, 1.0}
+    # targets are zeroed where inside weight is zero
+    np.testing.assert_allclose(tb * (1 - iw), 0, atol=1e-6)
+
+
+def test_generate_proposal_labels_classes():
+    rng = np.random.RandomState(3)
+    N, R, G, B, C = 1, 12, 2, 6, 5
+    gt_boxes = np.array([[[4, 4, 20, 20], [30, 30, 44, 44]]], 'float32')
+    gt_cls = np.array([[[2], [4]]], 'int64')
+    # proposals: 0-3 near gt0, 4-7 near gt1, rest far away
+    rois = np.zeros((N, R, 4), 'float32')
+    for i in range(4):
+        rois[0, i] = [4 + i, 4 + i, 20 + i, 20 + i]
+        rois[0, 4 + i] = [30 + i, 30 + i, 44 + i, 44 + i]
+    for i in range(8, R):
+        rois[0, i] = [50 + i, 50 + i, 52 + i, 52 + i]
+    rv = fluid.layers.data('rois', shape=[R, 4], dtype='float32')
+    gcv = fluid.layers.data('gc', shape=[G, 1], dtype='int64')
+    gbv = fluid.layers.data('gb', shape=[G, 4], dtype='float32')
+    outs = layers.generate_proposal_labels(
+        rv, gcv, None, gbv, batch_size_per_im=B, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=C)
+    o_rois, o_lab, o_tgt, o_inw, o_outw = outs
+    exe = fluid.Executor()
+    got = [np.asarray(v) for v in exe.run(
+        feed={'rois': rois, 'gc': gt_cls, 'gb': gt_boxes},
+        fetch_list=list(outs))]
+    o_rois, o_lab, o_tgt, o_inw, o_outw = got
+    assert o_rois.shape == (N, B, 4) and o_lab.shape == (N, B, 1)
+    assert o_tgt.shape == (N, B, 4 * C)
+    labs = o_lab[0, :, 0]
+    # fg rows carry the matched gt class (2 or 4), bg rows 0
+    fg = labs[labs > 0]
+    assert set(fg.tolist()) <= {2, 4} and len(fg) >= 2
+    # bbox targets live only in the labeled class slot
+    for i, l in enumerate(labs):
+        slots = o_inw[0, i].reshape(C, 4).sum(1)
+        if l > 0:
+            assert slots[l] == 4 and slots.sum() == 4
+        else:
+            assert slots.sum() == 0
+
+
+def test_generate_mask_labels_rasterizes_polygon():
+    # one roi exactly covering a square polygon -> solid mask
+    N, B, G, P, C, R = 1, 2, 1, 4, 3, 8
+    rois = np.array([[[10, 10, 26, 26], [0, 0, 8, 8]]], 'float32')
+    labels = np.array([[[1], [0]]], 'int32')      # roi1 is bg
+    segms = np.array([[[[10, 10], [26, 10], [26, 26], [10, 26]]]],
+                     'float32')
+    roi_gt = np.array([[[0], [-1]]], 'int32')
+    rv = fluid.layers.data('rois', shape=[B, 4], dtype='float32')
+    lv = fluid.layers.data('lab', shape=[B, 1], dtype='int32')
+    sv = fluid.layers.data('seg', shape=[G, P, 2], dtype='float32')
+    gv = fluid.layers.data('rgi', shape=[B, 1], dtype='int32')
+    mask_rois, has_mask, mask = layers.generate_mask_labels(
+        None, None, None, sv, rv, lv, num_classes=C, resolution=R,
+        roi_gt_index=gv)
+    exe = fluid.Executor()
+    mr, hm, mk = [np.asarray(v) for v in exe.run(
+        feed={'rois': rois, 'lab': labels, 'seg': segms, 'rgi': roi_gt},
+        fetch_list=[mask_rois, has_mask, mask])]
+    assert hm[0, 0, 0] == 1 and hm[0, 1, 0] == 0
+    m = mk[0, 0].reshape(C, R, R)
+    # class-1 slot: every sampled point is inside the square
+    assert (m[1] == 1).all()
+    # other class slots are ignore (-1)
+    assert (m[0] == -1).all() and (m[2] == -1).all()
+    # bg roi contributes nothing
+    assert (mk[0, 1] == -1).all()
