@@ -12,8 +12,15 @@ from .memory_usage_calc import memory_usage  # noqa
 from .op_frequence import op_freq_statistic  # noqa
 from . import quantize  # noqa
 from .quantize import QuantizeTranspiler  # noqa
+from . import calibration  # noqa
+from .calibration import Calibrator  # noqa
+from . import slim  # noqa
+from . import decoder  # noqa
+from .decoder import (InitState, StateCell, TrainingDecoder,  # noqa
+                      BeamSearchDecoder)
 
 __all__ = []
 __all__ += trainer.__all__
 __all__ += inferencer.__all__
-__all__ += ['memory_usage', 'op_freq_statistic', 'QuantizeTranspiler']
+__all__ += ['memory_usage', 'op_freq_statistic', 'QuantizeTranspiler',
+            'Calibrator', 'slim']

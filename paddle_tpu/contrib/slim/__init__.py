@@ -1,0 +1,15 @@
+"""paddle_tpu.contrib.slim — model compression toolkit.
+
+Parity: reference contrib/slim/ (prune/, quantization/, core/).  The
+reference organizes compression as IrGraph passes driven by a config-file
+Compressor; here each pass is direct Program surgery (the whole block is
+one XLA executable, so there is no separate IR graph layer to rewrite).
+"""
+from . import prune  # noqa
+from .prune import Pruner, MagnitudePruner, RatioPruner, SensitivePruner  # noqa
+from . import quantization  # noqa
+from .quantization import (QuantizationTransformPass,  # noqa
+                           QuantizationFreezePass, ConvertToInt8Pass,
+                           TransformForMobilePass)
+
+__all__ = (prune.__all__ + quantization.__all__)

@@ -1,0 +1,133 @@
+"""Weight pruning passes.
+
+Parity: reference contrib/slim/prune/pruner.py (Pruner, MagnitudePruner,
+RatioPruner).  The reference builds mask subgraphs with layers; the same
+graph-building API is kept here, plus `apply`, which masks the scope
+weights in place — the actual sparsification step the reference leaves to
+its Compressor driver.
+"""
+import numpy as np
+
+__all__ = ['Pruner', 'MagnitudePruner', 'RatioPruner', 'SensitivePruner']
+
+
+class Pruner(object):
+    """Base class: `prune(param)` returns a zeros-mask Variable
+    (graph mode) and `mask_numpy(w)` the equivalent numpy mask."""
+
+    def prune(self, param, **kw):
+        raise NotImplementedError
+
+    def mask_numpy(self, w, **kw):
+        raise NotImplementedError
+
+    def apply(self, program, scope=None, params=None):
+        """Zero masked weights in the scope, in place.  Returns
+        {param name: sparsity} for the pruned params."""
+        from ...core.executor import global_scope
+        from ...core.framework import Parameter
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        chosen = params
+        out = {}
+        for name, v in block.vars.items():
+            if not isinstance(v, Parameter) or name not in scope:
+                continue
+            if chosen is not None and name not in chosen:
+                continue
+            w = np.asarray(scope.vars[name])
+            mask = self.mask_numpy(w, name=name)
+            pruned = np.where(mask, 0.0, w).astype(w.dtype)
+            scope.vars[name] = scope.vars[name] * 0 + pruned
+            out[name] = float(mask.mean())
+        return out
+
+
+class MagnitudePruner(Pruner):
+    """Zero weights with |w| below a fixed threshold
+    (ref slim/prune/pruner.py MagnitudePruner)."""
+
+    def __init__(self, threshold):
+        self.threshold = float(threshold)
+
+    def prune(self, param, threshold=None):
+        from ... import layers
+        th = threshold
+        if th is None:
+            th = layers.fill_constant([1], 'float32', self.threshold)
+        return layers.less_than(layers.abs(param), th)
+
+    def mask_numpy(self, w, name=None, threshold=None):
+        return np.abs(w) < (self.threshold if threshold is None
+                            else threshold)
+
+
+class RatioPruner(Pruner):
+    """Keep the top `ratio` fraction of weights by magnitude, zero the
+    rest (ref RatioPruner; `ratios` maps param name -> keep ratio, '*'
+    is the default)."""
+
+    def __init__(self, ratios=None):
+        self.ratios = ratios or {}
+
+    def _ratio_for(self, name):
+        if name in self.ratios:
+            return float(self.ratios[name])
+        return float(self.ratios.get('*', 1.0))
+
+    def prune(self, param, ratio=None):
+        from ... import layers
+        rat = ratio if ratio is not None else self._ratio_for(param.name)
+        if rat >= 1.0:
+            zeros = layers.fill_constant([1], 'float32', 0.0)
+            return layers.less_than(layers.abs(param), zeros)
+        k = max(int(rat * int(np.prod(param.shape))), 1)
+        flat = layers.reshape(layers.abs(param), [1, -1])
+        topk, _ = layers.topk(flat, k=k)
+        th = layers.slice(topk, axes=[1], starts=[k - 1], ends=[k])
+        th = layers.reshape(th, [1])
+        return layers.less_than(layers.abs(param), th)
+
+    def mask_numpy(self, w, name=None, ratio=None):
+        rat = ratio if ratio is not None else self._ratio_for(name or '')
+        if rat >= 1.0:
+            return np.zeros_like(w, dtype=bool)
+        k = max(int(rat * w.size), 1)
+        th = np.sort(np.abs(w).ravel())[::-1][k - 1]
+        return np.abs(w) < th
+
+
+class SensitivePruner(Pruner):
+    """Prune each param to the largest ratio whose loss delta stays under
+    `tolerance` (a compact stand-in for the reference Compressor's
+    sensitivity analysis in slim/core)."""
+
+    def __init__(self, eval_fn, candidate_ratios=(0.9, 0.7, 0.5, 0.3),
+                 tolerance=0.05):
+        self.eval_fn = eval_fn
+        self.candidates = sorted(candidate_ratios, reverse=True)
+        self.tolerance = float(tolerance)
+        self.chosen = {}
+
+    def mask_numpy(self, w, name=None, ratio=None):
+        rat = self.chosen.get(name, 1.0) if ratio is None else ratio
+        return RatioPruner({'*': rat}).mask_numpy(w)
+
+    def search(self, program, scope, params):
+        """Pick per-param keep ratios by trial pruning + eval_fn()."""
+        base = float(self.eval_fn())
+        for name in params:
+            orig = np.asarray(scope.vars[name]).copy()
+            best = 1.0
+            for rat in self.candidates:
+                mask = RatioPruner({'*': rat}).mask_numpy(orig)
+                scope.vars[name] = scope.vars[name] * 0 + np.where(
+                    mask, 0.0, orig).astype(orig.dtype)
+                score = float(self.eval_fn())
+                if score <= base + self.tolerance:
+                    best = rat
+                else:
+                    break
+            scope.vars[name] = scope.vars[name] * 0 + orig
+            self.chosen[name] = best
+        return dict(self.chosen)

@@ -1,0 +1,72 @@
+"""Quantization passes, slim-style API.
+
+Parity: reference contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass, QuantizationFreezePass, ConvertToInt8Pass,
+TransformForMobilePass).  The reference rewrites an IrGraph; under
+whole-block XLA lowering the Program IS the graph, so each pass is a thin
+driver over the same machinery QuantizeTranspiler uses — one set of
+semantics, two public APIs (transpiler-era and slim-era), like the
+reference ships.
+"""
+from ..quantize import QuantizeTranspiler
+
+__all__ = ['QuantizationTransformPass', 'QuantizationFreezePass',
+           'ConvertToInt8Pass', 'TransformForMobilePass']
+
+
+class QuantizationTransformPass(object):
+    """Insert fake-quant/dequant pairs for QAT
+    (ref quantization_pass.py:28 QuantizationTransformPass.apply)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type='abs_max',
+                 weight_quantize_type='abs_max', window_size=10000,
+                 moving_rate=0.9):
+        self.scope = scope
+        self._t = QuantizeTranspiler(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type,
+            weight_quantize_type=weight_quantize_type,
+            window_size=window_size, moving_rate=moving_rate)
+
+    def apply(self, program, startup_program=None):
+        return self._t.training_transpile(program, startup_program)
+
+
+class QuantizationFreezePass(object):
+    """Fold trained quant state into an inference program
+    (ref QuantizationFreezePass.apply)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8,
+                 activation_bits=8, weight_quantize_type='abs_max'):
+        self.scope = scope
+        self._t = QuantizeTranspiler(weight_bits=weight_bits,
+                                     activation_bits=activation_bits)
+
+    def apply(self, program):
+        return self._t.freeze_program(program, scope=self.scope)
+
+
+class ConvertToInt8Pass(object):
+    """Pack weights as int8 + scale scope artifacts
+    (ref ConvertToInt8Pass.apply)."""
+
+    def __init__(self, scope=None, place=None, weight_bits=8):
+        self.scope = scope
+        self._t = QuantizeTranspiler(weight_bits=weight_bits)
+
+    def apply(self, program):
+        return self._t.convert_to_int8(program, scope=self.scope)
+
+
+class TransformForMobilePass(object):
+    """The reference pass renames fake ops to mobile 'quantize'/
+    'dequantize' kernels for Paddle-Mobile.  There is no mobile runtime
+    here; the pass validates and returns the program unchanged."""
+
+    def __init__(self, *a, **kw):
+        pass
+
+    def apply(self, program):
+        return program

@@ -1,0 +1,153 @@
+"""contrib.slim pruners + post-training int8 Calibrator.
+
+Model: reference contrib/slim/unitest/ + contrib/tests (KL calibration of
+conv/fc nets; pruning masks by magnitude/ratio).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import Calibrator
+from paddle_tpu.contrib.calibration import kl_scale
+from paddle_tpu.contrib.slim import (MagnitudePruner, RatioPruner,
+                                     QuantizationTransformPass,
+                                     QuantizationFreezePass)
+
+
+def _train_regressor(seed=0, steps=60):
+    rng = np.random.RandomState(seed)
+    w_true = rng.rand(8, 1).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data('x', shape=[8], dtype='float32')
+            y = layers.data('y', shape=[1], dtype='float32')
+            h = layers.fc(x, 16, act='relu')
+            pred = layers.fc(h, 1)
+            loss = layers.reduce_mean(layers.square(pred - y))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            xb = rng.rand(32, 8).astype('float32')
+            exe.run(main, feed={'x': xb, 'y': xb @ w_true},
+                    fetch_list=[loss])
+    return main, scope, exe, pred, w_true, rng
+
+
+# ---------------------------------------------------------------- prune
+
+def test_magnitude_pruner_masks_small_weights():
+    main, scope, exe, pred, w_true, rng = _train_regressor()
+    with fluid.scope_guard(scope):
+        wname = [n for n in scope.vars if n.endswith('.w_0')][0]
+        w = np.asarray(scope.vars[wname])
+        th = float(np.median(np.abs(w)))
+        sparsity = MagnitudePruner(th).apply(main, scope, params=[wname])
+        assert wname in sparsity and 0.3 < sparsity[wname] < 0.7
+        w2 = np.asarray(scope.vars[wname])
+        assert ((np.abs(w) < th) == (w2 == 0)).all()
+
+
+def test_ratio_pruner_keeps_top_fraction():
+    main, scope, exe, pred, w_true, rng = _train_regressor(seed=1)
+    with fluid.scope_guard(scope):
+        wname = [n for n in scope.vars if n.endswith('.w_0')][0]
+        RatioPruner({'*': 0.25}).apply(main, scope, params=[wname])
+        w2 = np.asarray(scope.vars[wname])
+        kept = (w2 != 0).mean()
+        assert 0.2 <= kept <= 0.3, kept
+
+
+def test_ratio_pruner_graph_mask():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = layers.create_parameter([4, 4], 'float32', name='pw')
+        mask = RatioPruner({'*': 0.5}).prune(p)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rng = np.random.RandomState(2)
+        w = rng.randn(4, 4).astype('float32')
+        scope.vars['pw'] = scope.vars['pw'] * 0 + w
+        mv, = exe.run(main, fetch_list=[mask])
+    mv = np.asarray(mv)
+    # mask marks the weights to ZERO: the bottom half by magnitude
+    assert mv.sum() == 8
+    th = np.sort(np.abs(w).ravel())[::-1][7]
+    assert (mv.astype(bool) == (np.abs(w) < th)).all()
+
+
+# ---------------------------------------------------------- calibration
+
+def test_kl_scale_clips_outliers():
+    rng = np.random.RandomState(3)
+    body = rng.randn(100000).astype('float32')
+    outliers = np.array([40.0, -45.0, 60.0], 'float32')
+    s = kl_scale([np.concatenate([body, outliers])])
+    assert s < 30.0, s                      # clips the heavy tail
+    assert s > np.percentile(np.abs(body), 95), s
+
+
+def test_calibrator_int8_close_to_fp32():
+    main, scope, exe, pred, w_true, rng = _train_regressor(seed=4)
+    infer = main.clone(for_test=True)
+    with fluid.scope_guard(scope):
+        calib = Calibrator(infer, scope=scope, algo='KL')
+        assert calib._targets, 'no activations found to calibrate'
+        for _ in range(8):
+            xb = rng.rand(32, 8).astype('float32')
+            calib.sample(exe, feed={'x': xb, 'y': xb @ w_true})
+        int8_prog = calib.freeze()
+        types = [op.type for op in int8_prog.global_block().ops]
+        assert 'quantize_dequantize_fixed_scale' in types
+        xt = rng.rand(16, 8).astype('float32')
+        fp32_pred, = exe.run(infer, feed={'x': xt, 'y': xt @ w_true},
+                             fetch_list=[pred])
+        int8_pred, = exe.run(int8_prog, feed={'x': xt, 'y': xt @ w_true},
+                             fetch_list=[pred])
+        packed = calib.save_int8_weights()
+    fp32_pred = np.asarray(fp32_pred)
+    int8_pred = np.asarray(int8_pred)
+    # stated accuracy contract: int8 within 2% relative of fp32 range
+    span = fp32_pred.max() - fp32_pred.min() + 1e-6
+    rel = np.abs(fp32_pred - int8_pred).max() / span
+    assert rel < 0.02, rel
+    assert all(q.dtype == np.int8 for q, _ in packed.values())
+
+
+def test_slim_quantization_passes_roundtrip():
+    rng = np.random.RandomState(5)
+    w_true = rng.rand(8, 1).astype('float32')
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data('x', shape=[8], dtype='float32')
+            y = layers.data('y', shape=[1], dtype='float32')
+            pred = layers.fc(layers.fc(x, 16, act='relu'), 1)
+            loss = layers.reduce_mean(layers.square(pred - y))
+            QuantizationTransformPass(scope=scope).apply(main, startup)
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    types = [op.type for op in main.global_block().ops]
+    assert sum(t.startswith('fake_quantize_dequantize')
+               for t in types) == 4
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(40):
+            xb = rng.rand(32, 8).astype('float32')
+            exe.run(main, feed={'x': xb, 'y': xb @ w_true},
+                    fetch_list=[loss])
+        infer = main.clone(for_test=True)
+        QuantizationFreezePass(scope=scope).apply(infer)
+        xt = rng.rand(8, 8).astype('float32')
+        a, = exe.run(main.clone(for_test=True),
+                     feed={'x': xt, 'y': xt @ w_true}, fetch_list=[pred])
+        b, = exe.run(infer, feed={'x': xt, 'y': xt @ w_true},
+                     fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
