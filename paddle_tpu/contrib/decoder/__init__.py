@@ -1,0 +1,5 @@
+from . import beam_search_decoder  # noqa
+from .beam_search_decoder import (InitState, StateCell,  # noqa
+                                  TrainingDecoder, BeamSearchDecoder)
+
+__all__ = beam_search_decoder.__all__

@@ -1,0 +1,329 @@
+"""High-level seq2seq decoder API: StateCell / TrainingDecoder /
+BeamSearchDecoder.
+
+Parity: reference contrib/decoder/beam_search_decoder.py:43 (InitState),
+:159 (StateCell), :384 (TrainingDecoder), :523 (BeamSearchDecoder).  One
+StateCell describes the per-step recurrence; TrainingDecoder runs it over
+the gold sequence (teacher forcing), BeamSearchDecoder runs it
+autoregressively with beam tracking.
+
+TPU-native lowering: the reference drives decoding with a While op over
+LoD tensor arrays whose beam width shrinks as hypotheses finish.  Here
+the beam width is STATIC — every source keeps beam_size rows, finished
+rows re-select end_id (the dense beam_search op, ops/sequence.py:386) —
+and the decode loop is unrolled at build time over max_len steps, so XLA
+sees a straight-line graph with shared weights.  TrainingDecoder lowers
+through DynamicRNN's single lax.scan.
+"""
+import contextlib
+
+from ...core.framework import Variable
+from ...core.layer_helper import LayerHelper
+from ... import layers
+
+__all__ = ['InitState', 'StateCell', 'TrainingDecoder',
+           'BeamSearchDecoder']
+
+
+class _DecoderType(object):
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState(object):
+    """Initial value of a StateCell state (ref :43): either an explicit
+    `init` Variable or (shape, value) zeros-like boot."""
+
+    def __init__(self, init=None, shape=None, value=0.0,
+                 init_boot=None, need_reorder=False, dtype='float32'):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError('InitState needs init= or init_boot= '
+                             '(batch reference for the boot fill)')
+        else:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=[-1] + list(shape),
+                dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell(object):
+    """Carrier of decoder inputs/states + the user's updater function
+    (ref :159).  The same cell (and weights) serves both decoders."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self.helper = LayerHelper('state_cell', name=name)
+        self._inputs = dict(inputs)          # name -> placeholder/None
+        self._init_states = dict(states)     # name -> InitState
+        self._state_names = list(states)
+        self._out_state = out_state
+        self._cur_states = {}
+        self._cur_inputs = {}
+        self._updater = None
+        self._decoder = None
+
+    # -- decoder handshake
+    def _enter_decoder(self, decoder):
+        if self._decoder is not None:
+            raise ValueError('StateCell is already inside a decoder')
+        self._decoder = decoder
+
+    def _leave_decoder(self, decoder):
+        if self._decoder is not decoder:
+            raise ValueError('StateCell is not inside this decoder')
+        self._decoder = None
+
+    # -- user API
+    def get_state(self, name):
+        if name not in self._cur_states:
+            raise ValueError('unknown state %r (have %s)'
+                             % (name, self._state_names))
+        return self._cur_states[name]
+
+    def get_input(self, name):
+        if name not in self._cur_inputs:
+            raise ValueError('input %r was not fed to compute_state'
+                             % name)
+        return self._cur_inputs[name]
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def state_updater(self, updater):
+        self._updater = updater
+        return updater
+
+    def compute_state(self, inputs):
+        """Run the updater once with `inputs` (dict name -> Variable)."""
+        if self._updater is None:
+            raise ValueError('no @state_cell.state_updater registered')
+        self._cur_inputs = dict(inputs)
+        self._updater(self)
+
+    def update_states(self):
+        """Commit the updated states to the enclosing decoder (training:
+        DynamicRNN memories; beam search: beam-reordered carries)."""
+        if self._decoder is None:
+            raise ValueError('update_states outside a decoder block')
+        self._decoder._commit_states(self)
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder(object):
+    """Teacher-forced decoder over the gold target sequence (ref :384);
+    lowers through DynamicRNN (one lax.scan)."""
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        self._helper = LayerHelper('training_decoder', name=name)
+        self._rnn = layers.DynamicRNN()
+        self._type = _DecoderType.TRAINING
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self._mems = {}
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._rnn
+
+    @property
+    def type(self):
+        return self._type
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError('decoder.block() can only be entered once')
+        self._status = TrainingDecoder.IN_DECODER
+        with self._rnn.block():
+            yield
+        self._status = TrainingDecoder.AFTER_DECODER
+        self._state_cell._leave_decoder(self)
+
+    def step_input(self, x):
+        self._assert_in_block('step_input')
+        ipt = self._rnn.step_input(x)
+        if not self._mems:
+            # first step_input fixes the batch: bind state memories now
+            for name in self._state_cell._state_names:
+                init = self._state_cell._init_states[name]
+                mem = self._rnn.memory(init=init.value)
+                self._mems[name] = mem
+                self._state_cell._cur_states[name] = mem
+        return ipt
+
+    def static_input(self, x):
+        self._assert_in_block('static_input')
+        return self._rnn.static_input(x)
+
+    def output(self, *outputs):
+        self._assert_in_block('output')
+        self._rnn.output(*outputs)
+
+    def _commit_states(self, cell):
+        for name, mem in self._mems.items():
+            cell_cur = cell._cur_states[name]
+            if cell_cur is not mem:
+                self._rnn.update_memory(mem, cell_cur)
+
+    def __call__(self, *a, **kw):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError('decoder outputs are available after the '
+                             'block closes')
+        return self._rnn(*a, **kw)
+
+    def _assert_in_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError('%s must be called inside decoder.block()'
+                             % method)
+
+
+def _expand_to_beam(x, beam):
+    """[B, ...] -> [B*beam, ...], each source row repeated beam times
+    (the dense analog of the reference's sequence_expand by scores)."""
+    if beam == 1:
+        return x
+    shape = list(x.shape)
+    ex = layers.unsqueeze(x, axes=[1])
+    ex = layers.expand(ex, [1, beam] + [1] * (len(shape) - 1))
+    return layers.reshape(ex, [-1] + shape[1:])
+
+
+class BeamSearchDecoder(object):
+    """Autoregressive beam-search decoder (ref :523).
+
+    decode() unrolls max_len steps at build time: embed the previous
+    ids, run the StateCell on all B*beam rows, project to the
+    vocabulary, take topk, and run the dense beam_search op; states are
+    re-gathered by each step's parent indices.  __call__ returns the
+    backtraced (translation_ids, translation_scores), each
+    [B*beam, max_len]."""
+
+    def __init__(self, state_cell, init_ids, init_scores,
+                 target_dict_dim, word_dim, input_var_dict=None,
+                 topk_size=50, sparse_emb=True, max_len=100, beam_size=1,
+                 end_id=1, name=None, param_attr=None, bias_attr=None,
+                 emb_param_attr=None):
+        self._helper = LayerHelper('beam_search_decoder', name=name)
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = input_var_dict or {}
+        self._topk_size = min(topk_size, target_dict_dim)
+        self._sparse_emb = sparse_emb
+        self._max_len = max_len
+        self._beam_size = beam_size
+        self._end_id = end_id
+        # param_attr/bias_attr/emb_param_attr: optional NAMED attrs so the
+        # decode-time projection/embedding reuse the trained weights (the
+        # reference relies on unique_name alignment across separately
+        # built programs; explicit names are the robust equivalent)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._emb_param_attr = emb_param_attr
+        self._done = False
+        self._result = None
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    def _commit_states(self, cell):
+        pass  # decode() re-gathers states by parent index explicitly
+
+    def decode(self):
+        cell = self._state_cell
+        beam = self._beam_size
+        prev_ids = _expand_to_beam(self._init_ids, beam)      # [R, 1]
+        # only beam 0 starts live: [init_score, -1e9, ...] per source
+        if beam > 1:
+            dead = layers.fill_constant_batch_size_like(
+                self._init_scores, [-1, beam - 1], 'float32', -1e9)
+            sc = layers.concat([self._init_scores, dead], axis=1)
+            prev_scores = layers.reshape(sc, [-1, 1])
+        else:
+            prev_scores = self._init_scores
+        for name in cell._state_names:
+            cell._cur_states[name] = _expand_to_beam(
+                cell._init_states[name].value, beam)
+        static_feeds = {k: _expand_to_beam(v, beam)
+                        for k, v in self._input_var_dict.items()}
+
+        # every unrolled step must SHARE its weights: pin the param names
+        from ...param_attr import ParamAttr
+        emb_attr = self._emb_param_attr or ParamAttr(
+            name=self._helper.name + '_emb')
+        fc_w = self._param_attr or ParamAttr(
+            name=self._helper.name + '_fc.w')
+        fc_b = self._bias_attr or ParamAttr(
+            name=self._helper.name + '_fc.b')
+
+        step_ids, step_scores, step_parents = [], [], []
+        for _ in range(self._max_len):
+            emb = layers.embedding(
+                prev_ids, size=[self._target_dict_dim, self._word_dim],
+                dtype='float32', is_sparse=self._sparse_emb,
+                param_attr=emb_attr)
+            feed = dict(static_feeds)
+            for input_name in cell._inputs:
+                if input_name not in feed:
+                    feed[input_name] = emb
+            cell.compute_state(inputs=feed)
+            out = cell.out_state()                           # [R, H]
+            scores = layers.fc(out, self._target_dict_dim, act='softmax',
+                               param_attr=fc_w, bias_attr=fc_b)
+            topk_scores, topk_idx = layers.topk(scores, self._topk_size)
+            acc = layers.log(topk_scores) + prev_scores      # [R, K]
+            sel_ids, sel_scores, parent = layers.beam_search(
+                prev_ids, prev_scores, topk_idx, acc, beam,
+                end_id=self._end_id, return_parent_idx=True)
+            for name in cell._state_names:
+                cell._cur_states[name] = layers.gather(
+                    cell._cur_states[name], parent)
+            step_ids.append(sel_ids)
+            step_scores.append(sel_scores)
+            step_parents.append(parent)
+            prev_ids, prev_scores = sel_ids, sel_scores
+
+        ids_arr = layers.create_array('int64')
+        ids_arr.vars = step_ids
+        sc_arr = layers.create_array('float32')
+        sc_arr.vars = step_scores
+        pa_arr = layers.create_array('int32')
+        pa_arr.vars = step_parents
+        self._result = layers.beam_search_decode(
+            ids_arr, sc_arr, beam_size=beam, end_id=self._end_id,
+            parents=pa_arr)
+        self._done = True
+        self._state_cell._leave_decoder(self)
+        return self._result
+
+    def __call__(self):
+        if not self._done:
+            raise ValueError('call decode() before reading the results')
+        return self._result

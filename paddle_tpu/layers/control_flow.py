@@ -682,6 +682,11 @@ class DynamicRNN(_RecurrentBase):
         self._assert_in_block('step_input')
         if not isinstance(x, Variable):
             raise TypeError('step_input takes a Variable')
+        if x.block is self._sub:
+            raise ValueError(
+                'step_input sequence %r was built INSIDE the rnn block; '
+                'build the full [B, T, ...] sequence (e.g. the embedding) '
+                'before entering block()' % x.name)
         if x.shape is None or len(x.shape) < 2:
             raise ValueError('DynamicRNN step_input needs a padded '
                              '[B, T, ...] variable')

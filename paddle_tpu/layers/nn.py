@@ -40,6 +40,7 @@ __all__ = [
     'sampling_id', 'gaussian_random_batch_size_like', 'sum',
     'shuffle_channel', 'similarity_focus', 'hash', 'lod_reset',
     'autoincreased_step_counter', 'py_func',
+    'merge_selected_rows', 'get_tensor_from_selected_rows',
     # sequence family
     'sequence_conv', 'sequence_pool', 'sequence_softmax', 'sequence_expand',
     'sequence_expand_as', 'sequence_pad', 'sequence_unpad',
@@ -1826,3 +1827,35 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None,
                               'SentenceScores': sentence_scores},
                      attrs={'beam_size': beam_size, 'end_id': end_id})
     return sentence_ids, sentence_scores
+
+
+def merge_selected_rows(x, name=None):
+    """Merge duplicate rows of a SelectedRows input by summation.
+
+    Parity: reference nn.py merge_selected_rows /
+    operators/merge_selected_rows_op.cc.  SelectedRows is the reference's
+    sparse-gradient type ({rows, values} pairs where the same row id may
+    appear twice, e.g. two lookups of one embedding id).  This framework
+    has no SelectedRows runtime type: sparse gradients are ALREADY merged
+    — lookup_table's backward is a scatter-ADD into the dense table, which
+    is exactly the merge this op performs — so the op is a documented
+    identity on its dense input."""
+    helper = LayerHelper('merge_selected_rows', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='assign', inputs={'X': x},
+                     outputs={'Out': out}, attrs={})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """Densify a SelectedRows value into an ordinary tensor.
+
+    Parity: reference nn.py get_tensor_from_selected_rows /
+    operators/get_tensor_from_selected_rows_op.cc.  Gradients here are
+    always dense arrays (see merge_selected_rows), so the conversion is
+    an identity copy with the same graph surface."""
+    helper = LayerHelper('get_tensor_from_selected_rows', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='assign', inputs={'X': x},
+                     outputs={'Out': out}, attrs={})
+    return out

@@ -1,0 +1,157 @@
+"""contrib.decoder: StateCell / TrainingDecoder / BeamSearchDecoder.
+
+Model: reference contrib/tests/test_beam_search_decoder.py (train a tiny
+seq2seq with the StateCell API, then beam-decode with shared weights).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import (InitState, StateCell, TrainingDecoder,
+                                BeamSearchDecoder)
+from paddle_tpu.core.lod import create_lod_tensor
+
+V, E, H = 16, 8, 16
+END = 1
+
+
+def _build_cell(enc_h):
+    init = InitState(init=enc_h)
+    cell = StateCell(inputs={'x': None}, states={'h': init},
+                     out_state='h')
+
+    @cell.state_updater
+    def updater(state_cell):
+        x = state_cell.get_input('x')
+        h = state_cell.get_state('h')
+        nh = layers.fc(layers.concat([x, h], axis=1), H, act='tanh',
+                       param_attr=fluid.ParamAttr(name='cell_fc.w'),
+                       bias_attr=fluid.ParamAttr(name='cell_fc.b'))
+        state_cell.set_state('h', nh)
+    return cell
+
+
+def test_training_decoder_trains_and_beam_decoder_decodes():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            src = fluid.layers.data('src', shape=[1], dtype='int64',
+                                    lod_level=1)
+            trg = fluid.layers.data('trg', shape=[1], dtype='int64',
+                                    lod_level=1)
+            lab = fluid.layers.data('lab', shape=[1], dtype='int64',
+                                    lod_level=1)
+            semb = layers.embedding(
+                src, size=[V, E],
+                param_attr=fluid.ParamAttr(name='src_emb'))
+            enc_h = layers.fc(layers.sequence_pool(semb, 'last'), H,
+                              act='tanh',
+                              param_attr=fluid.ParamAttr(name='enc.w'))
+            cell = _build_cell(enc_h)
+            temb = layers.embedding(
+                trg, size=[V, E],
+                param_attr=fluid.ParamAttr(name='trg_emb'))
+            decoder = TrainingDecoder(cell)
+            with decoder.block():
+                word = decoder.step_input(temb)
+                cell.compute_state(inputs={'x': word})
+                cell.update_states()
+                decoder.output(cell.get_state('h'))
+            dec = decoder()
+            logits = layers.fc(
+                dec, V, param_attr=fluid.ParamAttr(name='dec_fc.w'),
+                bias_attr=fluid.ParamAttr(name='dec_fc.b'))
+            ce = layers.softmax_with_cross_entropy(logits, lab,
+                                                   soft_label=False)
+            from paddle_tpu.layers.nn import _copy_lod, _len_var
+            _copy_lod(lab, ce)
+            per_seq = layers.sequence_pool(ce, 'sum')
+            n_tok = layers.cast(layers.reduce_sum(_len_var(lab)),
+                                'float32')
+            loss = layers.reduce_sum(per_seq) / n_tok
+            fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+
+    def batch(n=8):
+        lens = rng.randint(2, 5, size=n)
+        srcs, trgs, labs = [], [], []
+        for L in lens:
+            s = rng.randint(2, V, (L, 1)).astype('int64')
+            srcs.append(s)
+            trgs.append(np.roll(s, 1, axis=0))
+            # toy task: always emit the source's LAST token
+            labs.append(np.full((L, 1), s[-1, 0], 'int64'))
+        return {'src': create_lod_tensor(srcs),
+                'trg': create_lod_tensor(trgs),
+                'lab': create_lod_tensor(labs)}
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(120):
+            lv, = exe.run(main, feed=batch(), fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # ---- beam decode with the trained weights (shared by name)
+        infer, istartup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(infer, istartup):
+            with fluid.unique_name.guard():
+                src_i = fluid.layers.data('src', shape=[1], dtype='int64',
+                                          lod_level=1)
+                semb_i = layers.embedding(
+                    src_i, size=[V, E],
+                    param_attr=fluid.ParamAttr(name='src_emb'))
+                enc_i = layers.fc(
+                    layers.sequence_pool(semb_i, 'last'), H, act='tanh',
+                    param_attr=fluid.ParamAttr(name='enc.w'))
+                cell_i = _build_cell(enc_i)
+                init_ids = fluid.layers.data('init_ids', shape=[1],
+                                             dtype='int64')
+                init_scores = fluid.layers.data('init_scores', shape=[1],
+                                                dtype='float32')
+                bs = BeamSearchDecoder(
+                    cell_i, init_ids, init_scores, target_dict_dim=V,
+                    word_dim=E, max_len=4, beam_size=2, end_id=END,
+                    param_attr=fluid.ParamAttr(name='dec_fc.w'),
+                    bias_attr=fluid.ParamAttr(name='dec_fc.b'),
+                    emb_param_attr=fluid.ParamAttr(name='trg_emb'))
+                bs.decode()
+                tr_ids, tr_scores = bs()
+        feed = batch(4)
+        B = 4
+        ids_v, sc_v = exe.run(
+            infer,
+            feed={'src': feed['src'],
+                  'init_ids': np.zeros((B, 1), 'int64'),
+                  'init_scores': np.zeros((B, 1), 'float32')},
+            fetch_list=[tr_ids, tr_scores])
+    ids_v = np.asarray(ids_v)          # [B*beam, max_len]
+    assert ids_v.shape == (B * 2, 4)
+    # the learned rule: first decoded token == each source's last token
+    last_tok = feed['src'].padded[
+        np.arange(B), feed['src'].lengths - 1, 0]
+    top_beam_first = ids_v[0::2, 0]    # beam 0 of each source
+    hits = (top_beam_first == last_tok).mean()
+    assert hits >= 0.75, (top_beam_first, last_tok)
+
+
+def test_state_cell_validations():
+    import pytest
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        cell = StateCell(inputs={'x': None},
+                         states={'h': InitState(init=x)}, out_state='h')
+        with pytest.raises(ValueError, match='state_updater'):
+            cell.compute_state({'x': x})
+
+        @cell.state_updater
+        def up(c):
+            c.set_state('h', c.get_input('x'))
+        with pytest.raises(ValueError, match='unknown state'):
+            cell.get_state('nope')
+        with pytest.raises(ValueError, match='outside a decoder'):
+            cell.update_states()
