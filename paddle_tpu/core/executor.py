@@ -251,6 +251,11 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
     import jax
     import jax.numpy as jnp
 
+    # SSA-graph race detection analog (SURVEY §2.8): fail def-use
+    # ordering bugs at build with the op+var named, not mid-trace
+    from .validation import validate_def_use
+    validate_def_use(program, feed_names)
+
     block = program.global_block()
     ops = block.ops
     required, written = _analyze(block, feed_names, fetch_names)
@@ -389,6 +394,11 @@ class Executor(object):
             if isinstance(v, LoDTensor):
                 feed_vals[k] = v.padded
                 feed_vals[k + '@LENGTH'] = v.lengths
+            elif hasattr(v, 'devices'):
+                # already a device array: pass through zero-copy (a feed
+                # uploaded once with jax.device_put is NOT round-tripped
+                # through the host every step)
+                feed_vals[k] = v
             else:
                 feed_vals[k] = np.asarray(v)
         # lod vars fed as plain dense arrays: synthesize full lengths

@@ -129,3 +129,24 @@ def test_check_nan_names_poisoned_param_update():
         with pytest.raises(RuntimeError, match='w_nan'):
             exe.run(main, feed={'x': np.array([[-1.0, -1.0]], 'float32')},
                     fetch_list=[loss])
+
+
+def test_def_use_validation_names_op_and_var():
+    import pytest
+    from paddle_tpu.core.framework import Operator
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        y = fluid.layers.scale(x, scale=2.0)
+        blk = main.global_block()
+        ghost = blk.create_var(name='never_written', shape=(2,),
+                               dtype='float32')
+        out = blk.create_var(name='bad_out', shape=(2,), dtype='float32')
+        blk.ops.append(Operator(blk, 'scale',
+                                inputs={'X': ghost},
+                                outputs={'Out': out},
+                                attrs={'scale': 1.0}))
+    exe = fluid.Executor()
+    with pytest.raises(ValueError, match='never_written'):
+        exe.run(main, feed={'x': np.zeros((1, 2), 'float32')},
+                fetch_list=[y])
