@@ -74,6 +74,13 @@ from .data_feed_desc import DataFeedDesc  # noqa
 
 from .core.framework import recompute_scope  # noqa
 
+# submodule aliases for reference-style imports (`from paddle.fluid
+# import executor`, `fluid.lod_tensor.create_lod_tensor(...)`, ...)
+from .core import executor  # noqa
+from .core import layer_helper  # noqa
+from .core import lod as lod_tensor  # noqa
+from .parallel import parallel_executor  # noqa
+
 
 def recompute(fn, *args, **kwargs):
     """jax.checkpoint for raw JAX callables (graph programs use

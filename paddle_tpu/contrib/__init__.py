@@ -18,6 +18,8 @@ from . import slim  # noqa
 from . import decoder  # noqa
 from .decoder import (InitState, StateCell, TrainingDecoder,  # noqa
                       BeamSearchDecoder)
+from . import reader  # noqa
+from . import utils  # noqa
 
 __all__ = []
 __all__ += trainer.__all__

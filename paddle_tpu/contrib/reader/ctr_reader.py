@@ -1,0 +1,148 @@
+"""CTR data reader (csv / svm formats, optionally gzipped).
+
+Parity: reference contrib/reader/ctr_reader.py:53, whose C++ reader op
+threads parse click-through-rate logs into a blocking queue.  Here the
+parse pipeline is host-side Python (the device pipeline is the jitted
+step): files stream through a buffered prefetch
+(paddle_tpu.reader.buffered), and the returned Reader yields feed dicts
+ready for Executor.run — start()/reset() keep the reference's pass
+protocol.
+
+Formats (reference docstring):
+  csv:  ``label d1,d2,... s1,s2,...``  (dense floats, sparse int ids)
+  svm:  ``label slot:sign slot:sign ...``
+"""
+import gzip
+
+import numpy as np
+
+from ... import reader as reader_mod
+
+__all__ = ['ctr_reader']
+
+
+def _open(path, file_type):
+    if file_type == 'gzip':
+        return gzip.open(path, 'rt')
+    return open(path, 'r')
+
+
+def _parse_csv(line, dense_slot_index, sparse_slot_index):
+    parts = line.split()
+    label = int(parts[0])
+    dense, sparse = [], []
+    for idx in dense_slot_index:
+        dense.extend(float(v) for v in parts[idx].split(','))
+    for idx in sparse_slot_index:
+        sparse.extend(int(v) for v in parts[idx].split(','))
+    return label, dense, sparse
+
+
+def _parse_svm(line, slots):
+    parts = line.split()
+    label = int(parts[0])
+    per_slot = {s: [] for s in slots}
+    for tok in parts[1:]:
+        slot, sign = tok.split(':')
+        slot = int(slot)
+        if slot in per_slot:
+            per_slot[slot].append(int(sign))
+    return label, per_slot
+
+
+class _CtrReader(object):
+    def __init__(self, feed_dict, file_type, file_format,
+                 dense_slot_index, sparse_slot_index, capacity,
+                 batch_size, file_list, slots):
+        if file_type not in ('gzip', 'plain'):
+            raise ValueError('file_type must be gzip or plain')
+        if file_format not in ('csv', 'svm'):
+            raise ValueError('file_format must be csv or svm')
+        self._feed_names = [getattr(v, 'name', v) for v in feed_dict]
+        self._file_type = file_type
+        self._file_format = file_format
+        self._dense = list(dense_slot_index or [])
+        self._sparse = list(sparse_slot_index or [])
+        self._capacity = capacity
+        self._batch_size = batch_size
+        self._file_list = list(file_list)
+        self._slots = list(slots or [])
+        self._running = False
+
+    def start(self):
+        """Begin a pass (the reference protocol: start each pass, reset
+        after the EOF)."""
+        self._running = True
+
+    def reset(self):
+        self._running = False
+
+    def _assert_running(self):
+        if not self._running:
+            raise ValueError('ctr_reader: call start() before iterating '
+                             'a pass (and reset() after it ends)')
+
+    def _rows(self):
+        for path in self._file_list:
+            with _open(path, self._file_type) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if self._file_format == 'csv':
+                        yield _parse_csv(line, self._dense, self._sparse)
+                    else:
+                        label, per_slot = _parse_svm(line, self._slots)
+                        yield (label,
+                               [v for s in self._slots
+                                for v in per_slot[s]], [])
+
+    def __call__(self):
+        self._assert_running()
+
+        def batches():
+            buf = []
+            for row in self._rows():
+                buf.append(row)
+                if len(buf) == self._batch_size:
+                    yield self._to_feed(buf)
+                    buf = []
+            if buf:
+                yield self._to_feed(buf)
+        return reader_mod.buffered(batches, max(1, self._capacity))()
+
+    @staticmethod
+    def _pad_ids(seqs):
+        width = max(len(s) for s in seqs)
+        out = np.zeros((len(seqs), width), np.int64)
+        for i, s in enumerate(seqs):
+            out[i, :len(s)] = s
+        return out
+
+    def _to_feed(self, rows):
+        labels = np.array([[r[0]] for r in rows], np.int64)
+        cols = [labels]
+        if self._file_format == 'csv':
+            cols.append(np.array([r[1] for r in rows], np.float32))
+            if any(len(r[2]) for r in rows):
+                cols.append(self._pad_ids([r[2] for r in rows]))
+        else:
+            # svm rows are ragged id lists — zero-pad to batch width
+            cols.append(self._pad_ids([r[1] for r in rows]))
+        if len(cols) != len(self._feed_names):
+            raise ValueError(
+                'ctr_reader produced %d columns for %d feed vars %s — '
+                'check dense/sparse_slot_index against feed_dict'
+                % (len(cols), len(self._feed_names), self._feed_names))
+        return dict(zip(self._feed_names, cols))
+
+
+def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
+               sparse_slot_index, capacity, thread_num, batch_size,
+               file_list, slots, name=None):
+    """Build the CTR reader (reference signature; `thread_num` is
+    absorbed by the buffered prefetch — host threads are not the
+    bottleneck when the step is one XLA executable)."""
+    return _CtrReader(feed_dict, file_type, file_format,
+                      dense_slot_index, sparse_slot_index, capacity,
+                      batch_size, file_list, slots)

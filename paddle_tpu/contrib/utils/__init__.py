@@ -1,0 +1,11 @@
+from . import hdfs_utils  # noqa
+from .hdfs_utils import HDFSClient, multi_download, multi_upload  # noqa
+from . import lookup_table_utils  # noqa
+from .lookup_table_utils import (  # noqa
+    load_persistables_for_increment, load_persistables_for_inference,
+    convert_dist_to_sparse_program)
+
+__all__ = ['HDFSClient', 'multi_download', 'multi_upload',
+           'load_persistables_for_increment',
+           'load_persistables_for_inference',
+           'convert_dist_to_sparse_program']

@@ -1,0 +1,111 @@
+"""HDFS shell helpers.
+
+Parity: reference contrib/utils/hdfs_utils.py (HDFSClient + parallel
+up/download), which shells out to the ``hadoop fs`` CLI.  The same
+subprocess protocol is kept; on hosts without a hadoop client every
+operation raises a clear EnvironmentError instead of a cryptic exec
+failure (TPU pods typically mount GCS/NFS instead of HDFS — point
+`hadoop_home` at a client install to use these)."""
+import os
+import subprocess
+
+__all__ = ['HDFSClient', 'multi_download', 'multi_upload']
+
+
+class HDFSClient(object):
+    def __init__(self, hadoop_home, configs=None):
+        self.hadoop_home = hadoop_home
+        self.configs = configs or {}
+        self._bin = os.path.join(hadoop_home, 'bin', 'hadoop')
+
+    def _cmd(self, *args):
+        if not os.path.exists(self._bin):
+            raise EnvironmentError(
+                'no hadoop client at %s — HDFSClient shells out to the '
+                '`hadoop fs` CLI exactly like the reference; install one '
+                'or stage data on GCS/NFS instead' % self._bin)
+        cmd = [self._bin, 'fs']
+        for k, v in self.configs.items():
+            cmd += ['-D', '%s=%s' % (k, v)]
+        cmd += list(args)
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        return r.returncode, r.stdout, r.stderr
+
+    def is_exist(self, hdfs_path):
+        rc, _, _ = self._cmd('-test', '-e', hdfs_path)
+        return rc == 0
+
+    def is_dir(self, hdfs_path):
+        rc, _, _ = self._cmd('-test', '-d', hdfs_path)
+        return rc == 0
+
+    def delete(self, hdfs_path):
+        rc, _, err = self._cmd('-rm', '-r', '-skipTrash', hdfs_path)
+        return rc == 0
+
+    def rename(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        rc, _, _ = self._cmd('-mv', src, dst)
+        return rc == 0
+
+    def makedirs(self, hdfs_path):
+        rc, _, _ = self._cmd('-mkdir', '-p', hdfs_path)
+        return rc == 0
+
+    def ls(self, hdfs_path):
+        rc, out, _ = self._cmd('-ls', hdfs_path)
+        if rc != 0:
+            return []
+        return [line.split()[-1] for line in out.splitlines()
+                if line and not line.startswith('Found')]
+
+    def lsr(self, hdfs_path):
+        rc, out, _ = self._cmd('-lsr', hdfs_path)
+        if rc != 0:
+            return []
+        return [line.split()[-1] for line in out.splitlines() if line]
+
+    def upload(self, hdfs_path, local_path, overwrite=False, retry_times=5):
+        if overwrite and self.is_exist(hdfs_path):
+            self.delete(hdfs_path)
+        rc, _, _ = self._cmd('-put', local_path, hdfs_path)
+        return rc == 0
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        if overwrite and os.path.exists(local_path):
+            os.remove(local_path)
+        rc, _, _ = self._cmd('-get', hdfs_path, local_path)
+        if rc == 0 and unzip and local_path.endswith('.gz'):
+            subprocess.run(['gunzip', '-f', local_path])
+        return rc == 0
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id, trainers,
+                   multi_processes=5):
+    """Each trainer downloads its 1/trainers shard of the listing
+    (reference semantics; sequential — host IO overlaps the device step
+    anyway)."""
+    entries = client.ls(hdfs_path)
+    mine = [e for i, e in enumerate(sorted(entries))
+            if i % trainers == trainer_id]
+    got = []
+    for e in mine:
+        dst = os.path.join(local_path, os.path.basename(e))
+        if client.download(e, dst):
+            got.append(dst)
+    return got
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False):
+    ups = []
+    for root, _, files in os.walk(local_path):
+        for f in files:
+            src = os.path.join(root, f)
+            rel = os.path.relpath(src, local_path)
+            dst = '%s/%s' % (hdfs_path.rstrip('/'), rel)
+            if client.upload(dst, src, overwrite=overwrite):
+                ups.append(dst)
+    return ups

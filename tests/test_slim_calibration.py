@@ -151,3 +151,44 @@ def test_slim_quantization_passes_roundtrip():
         b, = exe.run(infer, feed={'x': xt, 'y': xt @ w_true},
                      fetch_list=[pred])
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+# -------------------------------------------------------- contrib.reader
+
+def test_ctr_reader_csv_and_svm(tmp_path):
+    from paddle_tpu.contrib.reader.ctr_reader import ctr_reader
+    csv = tmp_path / 'a.csv'
+    csv.write_text('1 0.5,1.5 7,8\n0 2.0,3.0 9,10\n1 4.0,5.0 11,12\n')
+    r = ctr_reader(feed_dict=['label', 'dense', 'sparse'],
+                   file_type='plain', file_format='csv',
+                   dense_slot_index=[1], sparse_slot_index=[2],
+                   capacity=4, thread_num=1, batch_size=2,
+                   file_list=[str(csv)], slots=[])
+    r.start()
+    batches = list(r())
+    assert len(batches) == 2
+    b0 = batches[0]
+    np.testing.assert_array_equal(b0['label'], [[1], [0]])
+    np.testing.assert_allclose(b0['dense'], [[0.5, 1.5], [2.0, 3.0]])
+    np.testing.assert_array_equal(b0['sparse'], [[7, 8], [9, 10]])
+    r.reset()
+
+    svm = tmp_path / 'b.svm'
+    svm.write_text('1 3:100 4:200\n0 3:300\n')
+    r2 = ctr_reader(feed_dict=['label', 'ids'], file_type='plain',
+                    file_format='svm', dense_slot_index=[],
+                    sparse_slot_index=[], capacity=2, thread_num=1,
+                    batch_size=2, file_list=[str(svm)], slots=[3, 4])
+    r2.start()
+    (b,) = list(r2())
+    np.testing.assert_array_equal(b['label'], [[1], [0]])
+
+
+def test_ctr_reader_requires_start_and_validates_columns():
+    from paddle_tpu.contrib.reader.ctr_reader import ctr_reader
+    r = ctr_reader(feed_dict=['a', 'b', 'c', 'd'], file_type='plain',
+                   file_format='csv', dense_slot_index=[1],
+                   sparse_slot_index=[2], capacity=1, thread_num=1,
+                   batch_size=1, file_list=['/nonexistent'], slots=[])
+    with pytest.raises(ValueError, match='start'):
+        r()
