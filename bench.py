@@ -183,8 +183,8 @@ def main():
 
     on_tpu = platform not in (None, 'cpu')
     # transformer-base; dropout off so training uses the fused flash kernel
-    B = 32 if on_tpu else 4
-    T = 256 if on_tpu else 64
+    B = int(os.environ.get('BENCH_B', 32 if on_tpu else 4))
+    T = int(os.environ.get('BENCH_T', 256 if on_tpu else 64))
     vocab = 32000
     n_layer, n_head, d_model, d_inner = 6, 8, 512, 2048
 
