@@ -658,7 +658,15 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
-                               return_softmax=False):
+                               return_softmax=False, label_smooth_eps=0.0):
+    """Reference nn.py softmax_with_cross_entropy, plus a fused
+    `label_smooth_eps` (hard labels only): equivalent to
+    one_hot -> label_smooth -> soft_label=True but without ever
+    materializing the [..., V] smoothed-label tensor."""
+    if soft_label and label_smooth_eps:
+        raise ValueError(
+            'label_smooth_eps applies to hard labels only — with '
+            'soft_label=True smooth the labels yourself (label_smooth)')
     helper = LayerHelper('softmax_with_cross_entropy')
     loss = helper.create_variable_for_type_inference(logits.dtype)
     softmax_out = helper.create_variable_for_type_inference(logits.dtype)
@@ -666,7 +674,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                      inputs={'Logits': logits, 'Label': label},
                      outputs={'Loss': loss, 'Softmax': softmax_out},
                      attrs={'soft_label': soft_label,
-                            'ignore_index': ignore_index})
+                            'ignore_index': ignore_index,
+                            'label_smooth_eps': float(label_smooth_eps)})
     if return_softmax:
         return loss, softmax_out
     return loss

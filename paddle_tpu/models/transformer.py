@@ -178,13 +178,11 @@ def transformer(src_vocab, trg_vocab, max_len=64, n_layer=6, n_head=8,
                             bias_attr=ParamAttr(name='dec_post_ln_b'))
 
     logits = _linear(dec, trg_vocab, 'proj')            # [B, T, V]
-    if label_smooth_eps:
-        oh = layers.one_hot(lbl, depth=trg_vocab)
-        soft = layers.label_smooth(oh, epsilon=label_smooth_eps)
-        per_tok = layers.softmax_with_cross_entropy(
-            logits, soft, soft_label=True)
-    else:
-        per_tok = layers.softmax_with_cross_entropy(logits, lbl)
+    # fused label smoothing: the one_hot -> label_smooth -> soft-CE chain
+    # would materialize two [B, T, V] f32 buffers (>1 GB at bench shapes);
+    # the closed form needs only reductions over V
+    per_tok = layers.softmax_with_cross_entropy(
+        logits, lbl, label_smooth_eps=label_smooth_eps)
     # mask out PAD target positions: weight = 1 - trg_pad
     w = layers.elementwise_sub(
         layers.fill_constant_batch_size_like(trg_pad, [-1, max_len],

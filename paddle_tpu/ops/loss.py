@@ -49,6 +49,15 @@ def softmax_with_cross_entropy(ctx, ins, attrs):
             lab = jnp.expand_dims(lab, axis)
         picked = jnp.take_along_axis(logp, lab.astype(jnp.int32), axis=axis)
         loss = -picked
+        eps = attrs.get('label_smooth_eps', 0.0)
+        if eps:
+            # fused uniform label smoothing: -sum(soft*logp) with
+            # soft = (1-eps)*onehot + eps/V equals
+            # (1-eps)*hard_ce + eps*(-mean(logp)) — the [.., V] one-hot /
+            # smoothed-label tensors never materialize, and AD yields the
+            # same softmax-minus-soft gradient
+            loss = (1.0 - eps) * loss + eps * (
+                -jnp.mean(logp, axis=axis, keepdims=True))
         ignore = attrs.get('ignore_index', -100)
         loss = jnp.where(lab == ignore, jnp.zeros_like(loss), loss)
     return {'Loss': loss, 'Softmax': jnp.exp(logp)}

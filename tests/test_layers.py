@@ -223,3 +223,24 @@ def test_uniform_random_and_gaussian():
     assert res[0].shape == (4, 5)
     assert np.abs(res[0]).max() <= 2.0
     assert res[1].std() > 0.3
+
+
+def test_fused_label_smooth_ce_matches_explicit_chain():
+    rng = np.random.RandomState(0)
+    B, T, V = 3, 5, 17
+    eps = 0.1
+    logits = fluid.layers.data('lg', shape=[T, V], dtype='float32')
+    lbl = fluid.layers.data('lb', shape=[T, 1], dtype='int64')
+    fused = layers.softmax_with_cross_entropy(logits, lbl,
+                                              label_smooth_eps=eps)
+    oh = layers.one_hot(lbl, depth=V)
+    soft = layers.label_smooth(oh, epsilon=eps)
+    explicit = layers.softmax_with_cross_entropy(logits, soft,
+                                                 soft_label=True)
+    with pytest.raises(ValueError, match='hard labels'):
+        layers.softmax_with_cross_entropy(logits, soft, soft_label=True,
+                                          label_smooth_eps=eps)
+    lv = rng.randn(B, T, V).astype('float32')
+    lb = rng.randint(0, V, (B, T, 1)).astype('int64')
+    a, b = _run([fused, explicit], {'lg': lv, 'lb': lb})
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
