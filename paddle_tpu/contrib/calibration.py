@@ -176,3 +176,51 @@ class Calibrator(object):
         from .quantize import QuantizeTranspiler
         t = QuantizeTranspiler(weight_bits=self.weight_bits)
         return t.convert_to_int8(self.program, scope=self.scope)
+
+    def apply_int8(self, program=None):
+        """Emit a TRUE-int8 inference program: calibrated mul/conv2d ops
+        become mul_int8/conv2d_int8 (int8×int8→int32 on the MXU, 2× the
+        bf16 rate), reading int8-packed weights stored in the scope under
+        `<param>.int8`.  The reference analog is the MKLDNN int8 kernel
+        swap its calibrator performs."""
+        import jax.numpy as jnp
+        if self.weight_bits != 8:
+            raise ValueError(
+                'apply_int8 needs weight_bits=8: the int8 kernels assume '
+                'the 127-range packing convention (got %d bits)'
+                % self.weight_bits)
+        program = program or self.program.clone(for_test=True)
+        scales = self.scales()
+        packed = self.save_int8_weights()
+        for block in program.blocks:
+            for op in block.ops:
+                # matmul is excluded (transpose_x/y attrs don't map onto
+                # the flattened-GEMM kernel), as is mul with a flattened
+                # weight (y_num_col_dims != 1)
+                if op.type not in ('mul', 'conv2d'):
+                    continue
+                if op.type == 'mul' and \
+                        op.attrs.get('y_num_col_dims', 1) != 1:
+                    continue
+                w_slot = 'Filter' if op.type == 'conv2d' else 'Y'
+                x_slot = 'Input' if op.type == 'conv2d' else 'X'
+                wname = op.inputs.get(w_slot, [None])[0]
+                xname = op.inputs.get(x_slot, [None])[0]
+                if wname not in packed or xname not in scales:
+                    continue
+                q, wscale = packed[wname]
+                int8_name = wname + '.int8'
+                # the block var must exist in EVERY emitted program (the
+                # executor pulls persistables from block.vars); only the
+                # scope write is once-per-scope
+                block.create_var(name=int8_name, shape=q.shape,
+                                 dtype='int8', persistable=True)
+                if int8_name not in self.scope:
+                    self.scope.vars[int8_name] = jnp.asarray(q)
+                op.inputs[w_slot] = [int8_name]
+                op.type = op.type + '_int8'
+                op.attrs = dict(op.attrs)
+                op.attrs['x_scale'] = float(scales[xname])
+                op.attrs['w_scale'] = float(wscale)
+        program._bump()
+        return program

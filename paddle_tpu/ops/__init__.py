@@ -15,3 +15,4 @@ from . import sequence  # noqa
 from . import detection  # noqa
 from . import attention  # noqa
 from . import ctc_crf  # noqa
+from . import int8  # noqa

@@ -1,0 +1,60 @@
+"""True int8 inference kernels.
+
+Parity: the reference's int8 deployment path runs conv/fc on MKLDNN int8
+kernels after contrib/int8_inference calibration.  The TPU analog feeds
+the MXU int8×int8→int32 directly (2× the bf16 rate on v5e/v6e):
+activations quantize at their calibrated scale in-graph, weights are the
+int8 arrays Calibrator/QuantizeTranspiler packed, and the int32
+accumulator dequantizes by (x_scale · w_scale / 127²).
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register
+
+_Q = 127.0
+
+
+def _quantize(x, scale):
+    s = jnp.asarray(scale, jnp.float32)
+    q = jnp.clip(jnp.round(x / jnp.maximum(s, 1e-8) * _Q), -_Q, _Q)
+    return q.astype(jnp.int8)
+
+
+@register('mul_int8')
+def mul_int8(ctx, ins, attrs):
+    """reference mul_op flattened GEMM, int8 in / int32 accumulate."""
+    x, w = ins['X'], ins['Y']          # w already int8 [K, N]
+    xn = attrs.get('x_num_col_dims', 1)
+    xs = x.shape
+    x2 = x.reshape(int(np.prod(xs[:xn])), -1)
+    xq = _quantize(x2, attrs['x_scale'])
+    acc = lax.dot_general(
+        xq, w.astype(jnp.int8), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    deq = acc.astype(jnp.float32) * (
+        float(attrs['x_scale']) * float(attrs['w_scale']) / (_Q * _Q))
+    return {'Out': deq.reshape(xs[:xn] + w.shape[1:])}
+
+
+@register('conv2d_int8')
+def conv2d_int8(ctx, ins, attrs):
+    from .nn import _pair
+    x, w = ins['Input'], ins['Filter']  # w int8 OIHW
+    strides = _pair(attrs.get('strides', [1, 1]))
+    pads = _pair(attrs.get('paddings', [0, 0]))
+    dil = _pair(attrs.get('dilations', [1, 1]))
+    groups = attrs.get('groups', 1) or 1
+    xq = _quantize(x, attrs['x_scale'])
+    acc = lax.conv_general_dilated(
+        xq, w.astype(jnp.int8), window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil, feature_group_count=groups,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (
+        float(attrs['x_scale']) * float(attrs['w_scale']) / (_Q * _Q))
+    if 'Bias' in ins:
+        out = out + ins['Bias'].reshape(1, -1, 1, 1)
+    return {'Output': out}

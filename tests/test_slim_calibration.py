@@ -192,3 +192,44 @@ def test_ctr_reader_requires_start_and_validates_columns():
                    batch_size=1, file_list=['/nonexistent'], slots=[])
     with pytest.raises(ValueError, match='start'):
         r()
+
+
+def test_apply_int8_runs_true_int8_kernels():
+    main, scope, exe, pred, w_true, rng = _train_regressor(seed=8)
+    infer = main.clone(for_test=True)
+    with fluid.scope_guard(scope):
+        calib = Calibrator(infer, scope=scope, algo='abs_max')
+        for _ in range(8):
+            xb = rng.rand(32, 8).astype('float32')
+            calib.sample(exe, feed={'x': xb, 'y': xb @ w_true})
+        int8_prog = calib.apply_int8()
+        types = [op.type for op in int8_prog.global_block().ops]
+        assert 'mul_int8' in types and 'mul' not in types
+        xt = rng.rand(16, 8).astype('float32')
+        a, = exe.run(infer, feed={'x': xt, 'y': xt @ w_true},
+                     fetch_list=[pred])
+        b, = exe.run(int8_prog, feed={'x': xt, 'y': xt @ w_true},
+                     fetch_list=[pred])
+    a, b = np.asarray(a), np.asarray(b)
+    span = a.max() - a.min() + 1e-6
+    rel = np.abs(a - b).max() / span
+    # true-int8 (both operands quantized) stays within 4% of fp32 range
+    assert rel < 0.04, rel
+
+
+def test_apply_int8_twice_shares_scope_weights():
+    main, scope, exe, pred, w_true, rng = _train_regressor(seed=9)
+    infer = main.clone(for_test=True)
+    with fluid.scope_guard(scope):
+        calib = Calibrator(infer, scope=scope, algo='abs_max')
+        for _ in range(4):
+            xb = rng.rand(32, 8).astype('float32')
+            calib.sample(exe, feed={'x': xb, 'y': xb @ w_true})
+        p1 = calib.apply_int8()
+        p2 = calib.apply_int8()          # fresh clone, shared scope
+        xt = rng.rand(8, 8).astype('float32')
+        a, = exe.run(p1, feed={'x': xt, 'y': xt @ w_true},
+                     fetch_list=[pred])
+        b, = exe.run(p2, feed={'x': xt, 'y': xt @ w_true},
+                     fetch_list=[pred])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
