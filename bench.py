@@ -207,12 +207,7 @@ def main():
     exe = fluid.Executor()
     scope = fluid.Scope()
     rng = np.random.RandomState(0)
-    rows = []
-    for _ in range(B):
-        s = rng.randint(3, vocab, (T - 1,))
-        rows.append((np.concatenate([s, [1]]), np.concatenate([[0], s]),
-                     np.concatenate([s, [1]])))
-    feed = tr.make_batch(rows, T)
+    feed = tr.synthetic_batch(rng, B, T, vocab)
     tokens_per_step = float(np.sum(1.0 - feed['trg_pad']))
 
     n_params = sum(

@@ -211,6 +211,18 @@ def build(src_vocab=10000, trg_vocab=10000, max_len=64, n_layer=6, n_head=8,
     return out
 
 
+def synthetic_batch(rng, batch_size, max_len, vocab=32000):
+    """Full-length synthetic (src, trg_in, trg_out) feeds for benchmarks
+    (bench.py / tools/) — ONE definition so every harness measures the
+    same feed contract."""
+    rows = []
+    for _ in range(batch_size):
+        s = rng.randint(3, vocab, (max_len - 1,))
+        rows.append((np.concatenate([s, [1]]), np.concatenate([[0], s]),
+                     np.concatenate([s, [1]])))
+    return make_batch(rows, max_len)
+
+
 def make_batch(reader_batch, max_len, rng=None):
     """Convert wmt16-style (src, trg_in, trg_out) rows into dense feeds."""
     B = len(reader_batch)

@@ -6,12 +6,14 @@ ResNet-50, so the best one can be promoted to bench.py defaults.  MFU
 accounting and the chip peak are imported from bench.py — one metric,
 two tools.
 """
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, '.')
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 from bench import peak_flops  # noqa: E402
 
 
@@ -38,13 +40,7 @@ def bench_transformer(B, T, steps=20):
     main.set_amp(True)
     exe = fluid.Executor()
     scope = fluid.Scope()
-    rng = np.random.RandomState(0)
-    rows = []
-    for _ in range(B):
-        s = rng.randint(3, 32000, (T - 1,))
-        rows.append((np.concatenate([s, [1]]), np.concatenate([[0], s]),
-                     np.concatenate([s, [1]])))
-    feed = tr.make_batch(rows, T)
+    feed = tr.synthetic_batch(np.random.RandomState(0), B, T)
     with fluid.scope_guard(scope):
         exe.run(startup)
         feed = {k: jax.device_put(v) for k, v in feed.items()}
