@@ -98,7 +98,117 @@ def release_memory(input_program, skip_opt_set=None):
 
 
 class InferenceTranspiler(object):
-    """No-op shim: BN folding / conv+bias fusion are XLA fusions."""
+    """Inference graph optimization.
 
-    def transpile(self, program, place, scope=None):
+    Parity: reference transpiler/inference_transpiler.py — its main pass
+    folds inference-mode batch_norm into the preceding conv2d's weights
+    (`_fuse_batch_norm`).  XLA would fuse the BN *arithmetic* anyway, but
+    folding at transpile time deletes the BN ops and their 4 per-channel
+    state tensors from the program entirely: fewer buffers, a smaller
+    executable, and exact train-time numerics (w' = w·s/√(v+ε),
+    b' = (b−μ)·s/√(v+ε) + β)."""
+
+    def transpile(self, program, place=None, scope=None):
+        from .core.executor import global_scope
+        scope = scope if scope is not None else global_scope()
+        # consumer counts are PROGRAM-wide (sub-blocks included): a
+        # shared filter, a sub-block reader, or a second branch off the
+        # conv output must all veto the fold
+        consumers = {}
+        for blk in program.blocks:
+            for op in blk.ops:
+                for names in op.inputs.values():
+                    for n in names:
+                        consumers[n] = consumers.get(n, 0) + 1
+        for block in program.blocks:
+            producer = {}
+            for op in block.ops:
+                for names in op.outputs.values():
+                    for n in names:
+                        producer[n] = op
+            kept = []
+            for op in block.ops:
+                if op.type == 'batch_norm' and \
+                        op.attrs.get('is_test', False):
+                    src = op.inputs['X'][0]
+                    # match conv2d -> bn, or conv2d -> +bias -> bn (the
+                    # fc/conv layers emit the bias as elementwise_add)
+                    conv = None
+                    tail = None          # op whose output feeds the bn
+                    bias_name = None
+                    p = producer.get(src)
+                    if p is not None and p.type == 'elementwise_add' \
+                            and consumers.get(src, 0) == 1:
+                        q = producer.get(p.inputs['X'][0])
+                        if q is not None and q.type == 'conv2d' and \
+                                consumers.get(p.inputs['X'][0], 0) == 1:
+                            conv, tail = q, p
+                            bias_name = p.inputs['Y'][0]
+                    elif p is not None and p.type == 'conv2d' and \
+                            consumers.get(src, 0) == 1:
+                        conv = tail = p
+                        bias_name = conv.inputs.get('Bias', [None])[0]
+                    if conv is not None and self._fold(
+                            conv, op, scope, bias_name, consumers):
+                        # like the reference pass: the fused chain's last
+                        # op now WRITES the bn output's name, so fetches
+                        # and sub-block readers of it keep working
+                        y = op.outputs['Y'][0]
+                        out_slot = ('Output' if tail.type == 'conv2d'
+                                    else 'Out')
+                        tail.outputs[out_slot] = [y]
+                        yv = block._find_var_recursive(y)
+                        if yv is not None:
+                            yv.op = tail
+                        continue
+                kept.append(op)
+            block.ops = kept
+        program._bump()
         return program
+
+    @staticmethod
+    def _fold(conv, bn, scope, bias_name, consumers):
+        import numpy as np
+        names = {k: bn.inputs[k][0]
+                 for k in ('Scale', 'Bias', 'Mean', 'Variance')}
+        wname = conv.inputs['Filter'][0]
+        if wname not in scope or any(n not in scope
+                                     for n in names.values()):
+            return False
+        if bias_name is not None and bias_name not in scope:
+            return False
+        # weight-shared (siamese) convs: folding would scale the shared
+        # tensor once per BN — refuse
+        if consumers.get(wname, 0) > 1:
+            return False
+        if bias_name is not None and consumers.get(bias_name, 0) > 1:
+            return False
+        eps = bn.attrs.get('epsilon', 1e-5)
+        s = np.asarray(scope.vars[names['Scale']], np.float64)
+        b = np.asarray(scope.vars[names['Bias']], np.float64)
+        m = np.asarray(scope.vars[names['Mean']], np.float64)
+        v = np.asarray(scope.vars[names['Variance']], np.float64)
+        w = np.asarray(scope.vars[wname])
+        k = s / np.sqrt(v + eps)                      # [C_out]
+        w2 = (w.astype(np.float64) * k[:, None, None, None]).astype(
+            w.dtype)
+        scope.vars[wname] = scope.vars[wname] * 0 + w2
+        if bias_name is not None:
+            old = np.asarray(scope.vars[bias_name], np.float64)
+            new_b = ((old.reshape(-1) - m) * k + b).astype(w.dtype)
+            new_b = new_b.reshape(np.asarray(scope.vars[bias_name]).shape)
+            scope.vars[bias_name] = scope.vars[bias_name] * 0 + new_b
+        else:
+            # conv had no bias: materialize one holding the folded shift
+            import jax.numpy as jnp
+            blk = conv.block
+            bias_name = wname + '.bnfold_bias'
+            new_b = ((0.0 - m) * k + b).astype(w.dtype)
+            scope.vars[bias_name] = jnp.asarray(new_b)
+            blk.create_var(name=bias_name, shape=new_b.shape,
+                           dtype=str(new_b.dtype), persistable=True)
+            conv.inputs['Bias'] = [bias_name]
+            # a slot added post-construction needs its arity recorded
+            # (the executor indexes input_is_list at lowering)
+            conv.input_is_list['Bias'] = False
+        return True

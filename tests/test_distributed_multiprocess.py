@@ -1,0 +1,91 @@
+"""Multi-host runtime: 2 real processes bootstrap jax.distributed via the
+PADDLE_TRAINER_* env convention and run a cross-process psum.
+
+Model: the reference's multi-trainer NCCL2 bootstrap tests
+(tests/unittests/test_dist_*.py spawn trainer processes); here the
+coordination service is jax.distributed and the collective is an XLA
+psum over the global mesh.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from paddle_tpu.parallel import distributed as dist
+
+dist.init_parallel_env()
+rank = dist.get_rank()
+world = dist.get_world_size()
+assert world == 2, world
+
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import numpy as np
+devs = jax.devices()          # all processes see the global device list
+mesh = Mesh(np.asarray(devs), ('x',))
+
+@jax.jit
+def allsum(v):
+    return shard_map(lambda s: jax.lax.psum(s, 'x'),
+                     mesh=mesh, in_specs=P('x'), out_specs=P(None))(v)
+
+n = len(devs)
+x = jnp.arange(n, dtype=jnp.float32)
+out = np.asarray(jax.device_get(allsum(x)))
+expect = float(sum(range(n)))
+assert out.shape == () or out.size >= 1
+assert abs(float(out.ravel()[0]) - expect) < 1e-6, (out, expect)
+print('RANK_OK', rank, world, float(out.ravel()[0]), flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_psum(tmp_path):
+    port = _free_port()
+    eps = '127.0.0.1:%d,127.0.0.1:%d' % (port, port + 1)
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            'PADDLE_TRAINER_ID': str(rank),
+            'PADDLE_TRAINERS_NUM': '2',
+            'PADDLE_TRAINER_ENDPOINTS': eps,
+            'PADDLE_CURRENT_ENDPOINT': eps.split(',')[rank],
+            'JAX_PLATFORMS': 'cpu',
+            'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, '-c', _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=150)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip('jax.distributed bootstrap timed out in this '
+                    'environment')
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, 'rank %d failed:\n%s' % (rank, out)
+        assert 'RANK_OK' in out, out
+    # 2 procs x 2 local devices = 4 global: psum of arange(4) = 6
+    assert 'RANK_OK 0 2 6.0' in outs[0], outs[0]
+    assert 'RANK_OK 1 2 6.0' in outs[1], outs[1]

@@ -172,3 +172,99 @@ def test_async_executor_trains(tmp_path):
             if first is None:
                 first = val
         assert val < first, (first, val)
+
+
+def test_inference_transpiler_folds_conv_bn():
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[3, 8, 8], dtype='float32')
+            c = layers.conv2d(x, 6, 3, act=None)
+            h = layers.batch_norm(c, act='relu')
+            loss = layers.reduce_mean(h)
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):  # move BN stats off their init values
+            exe.run(main, feed={'x': rng.rand(4, 3, 8, 8).astype(
+                'float32')}, fetch_list=[loss])
+        infer = main.clone(for_test=True)
+        xt = rng.rand(2, 3, 8, 8).astype('float32')
+        before, = exe.run(infer, feed={'x': xt}, fetch_list=[h])
+        t = fluid.transpiler.InferenceTranspiler()
+        t.transpile(infer, scope=scope)
+        types = [op.type for op in infer.global_block().ops]
+        assert 'batch_norm' not in types, types
+        after, = exe.run(infer, feed={'x': xt}, fetch_list=[h])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               atol=2e-5)
+
+
+def test_inference_transpiler_fold_edge_cases():
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    rng = np.random.RandomState(1)
+
+    # (a) conv WITHOUT bias + bn, fetching the bn output directly
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[2, 6, 6], dtype='float32')
+            c = layers.conv2d(x, 4, 3, bias_attr=False)
+            h = layers.batch_norm(c)       # no act; h fetched directly
+            loss = layers.reduce_mean(h)
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(2):
+            exe.run(main, feed={'x': rng.rand(3, 2, 6, 6).astype(
+                'float32')}, fetch_list=[loss])
+        infer = main.clone(for_test=True)
+        xt = rng.rand(2, 2, 6, 6).astype('float32')
+        before, = exe.run(infer, feed={'x': xt}, fetch_list=[h])
+        fluid.transpiler.InferenceTranspiler().transpile(infer,
+                                                         scope=scope)
+        assert 'batch_norm' not in [op.type for op in
+                                    infer.global_block().ops]
+        after, = exe.run(infer, feed={'x': xt}, fetch_list=[h])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               atol=2e-5)
+
+    # (b) weight-SHARED convs must not fold (each bn has its own stats)
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[2, 6, 6], dtype='float32')
+            w = fluid.ParamAttr(name='shared_w')
+            a = layers.batch_norm(layers.conv2d(x, 4, 3, param_attr=w,
+                                                bias_attr=False))
+            b = layers.batch_norm(layers.conv2d(x, 4, 3, param_attr=w,
+                                                bias_attr=False))
+            loss = layers.reduce_mean(a + b)
+            fluid.optimizer.SGDOptimizer(0.01).minimize(loss)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup2)
+        for _ in range(2):
+            exe.run(main2, feed={'x': rng.rand(3, 2, 6, 6).astype(
+                'float32')}, fetch_list=[loss])
+        infer2 = main2.clone(for_test=True)
+        xt = rng.rand(2, 2, 6, 6).astype('float32')
+        before, = exe.run(infer2, feed={'x': xt}, fetch_list=[loss])
+        fluid.transpiler.InferenceTranspiler().transpile(infer2,
+                                                         scope=scope2)
+        # both bns kept — shared filter vetoes the fold
+        kinds = [op.type for op in infer2.global_block().ops]
+        assert kinds.count('batch_norm') == 2, kinds
+        after, = exe.run(infer2, feed={'x': xt}, fetch_list=[loss])
+    np.testing.assert_allclose(np.asarray(before), np.asarray(after),
+                               rtol=1e-6)
