@@ -1,0 +1,137 @@
+"""Book-chapter patterns end to end (model: reference tests/book/
+test_fit_a_line.py, test_recommender_system.py,
+test_understand_sentiment.py conv variant).
+
+The heavier chapters live elsewhere: recognize_digits / image
+classification in test_models.py, machine translation in
+test_rnn_blocks.py + test_beam_decoder.py, label semantic roles (CRF)
+in test_ctc_crf.py.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import create_lod_tensor
+
+
+def test_fit_a_line():
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(13, 1).astype('float32')
+    b_true = 0.5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data('x', shape=[13], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(300):
+            xb = rng.rand(32, 13).astype('float32')
+            lv, = exe.run(main, feed={'x': xb,
+                                      'y': xb @ w_true + b_true},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.02, (losses[0], losses[-1])
+
+
+def test_recommender_system_dual_tower():
+    """usr/mov towers of embeddings -> fc -> cos_sim, scaled to a 0-5
+    rating (the book's recommender network shape)."""
+    rng = np.random.RandomState(1)
+    N_USR, N_JOB, N_MOV, N_CAT = 40, 8, 60, 6
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            uid = layers.data('uid', shape=[1], dtype='int64')
+            job = layers.data('job', shape=[1], dtype='int64')
+            mid = layers.data('mid', shape=[1], dtype='int64')
+            cat = layers.data('cat', shape=[1], dtype='int64')
+            score = layers.data('score', shape=[1], dtype='float32')
+            usr = layers.concat(
+                [layers.embedding(uid, size=[N_USR, 16]),
+                 layers.embedding(job, size=[N_JOB, 8])], axis=1)
+            usr = layers.fc(usr, 32, act='tanh')
+            mov = layers.concat(
+                [layers.embedding(mid, size=[N_MOV, 16]),
+                 layers.embedding(cat, size=[N_CAT, 8])], axis=1)
+            mov = layers.fc(mov, 32, act='tanh')
+            sim = layers.cos_sim(usr, mov)
+            pred = layers.scale(sim, scale=5.0)
+            loss = layers.mean(layers.square_error_cost(pred, score))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    # synthetic preference structure: rating depends on (uid+mid) parity
+    def batch(n=64):
+        u = rng.randint(0, N_USR, (n, 1))
+        m = rng.randint(0, N_MOV, (n, 1))
+        return {'uid': u.astype('int64'),
+                'job': (u % N_JOB).astype('int64'),
+                'mid': m.astype('int64'),
+                'cat': (m % N_CAT).astype('int64'),
+                'score': np.where((u + m) % 2 == 0, 4.5,
+                                  0.5).astype('float32')}
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(150):
+            lv, = exe.run(main, feed=batch(), fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_conv():
+    """The book's sentiment conv net: embedding -> sequence_conv pools
+    over ragged reviews -> softmax classifier."""
+    rng = np.random.RandomState(2)
+    V, C = 100, 2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            words = layers.data('words', shape=[1], dtype='int64',
+                                lod_level=1)
+            label = layers.data('label', shape=[1], dtype='int64')
+            emb = layers.embedding(words, size=[V, 32])
+            conv3 = fluid.nets.sequence_conv_pool(
+                input=emb, num_filters=32, filter_size=3, act='tanh',
+                pool_type='max')
+            conv4 = fluid.nets.sequence_conv_pool(
+                input=emb, num_filters=32, filter_size=4, act='tanh',
+                pool_type='max')
+            pred = layers.fc(layers.concat([conv3, conv4], axis=1), C,
+                             act='softmax')
+            loss = layers.mean(layers.cross_entropy(pred, label))
+            acc = layers.accuracy(pred, label)
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    # toy rule: positive iff the review contains token 7
+    def batch(n=16):
+        rows, labs = [], []
+        for _ in range(n):
+            L = rng.randint(3, 9)
+            r = rng.randint(10, V, (L, 1)).astype('int64')
+            if rng.rand() < 0.5:
+                r[rng.randint(L), 0] = 7
+                labs.append([1])
+            else:
+                labs.append([0])
+            rows.append(r)
+        return {'words': create_lod_tensor(rows),
+                'label': np.array(labs, 'int64')}
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        accs = []
+        for _ in range(120):
+            av, = exe.run(main, feed=batch(), fetch_list=[acc])
+            accs.append(float(np.asarray(av).reshape(())))
+    assert np.mean(accs[-10:]) > 0.85, np.mean(accs[-10:])
