@@ -16,8 +16,13 @@ from . import learning_rate_scheduler
 from .learning_rate_scheduler import *  # noqa
 from . import detection
 from .detection import *  # noqa
+from . import layer_function_generator
+from .layer_function_generator import (  # noqa
+    deprecated, generate_layer_fn, generate_layer_fn_noattr, autodoc,
+    templatedoc)
 
 __all__ = []
+__all__ += layer_function_generator.__all__
 __all__ += nn.__all__
 __all__ += io.__all__
 __all__ += tensor.__all__

@@ -1,0 +1,77 @@
+"""Layer codegen utilities.
+
+Parity: reference layers/layer_function_generator.py, which generates
+thin layer wrappers + docstrings from C++ OpProto descriptors.  There
+are no OpProtos here — ops are pure-JAX impls in the registry — so
+`generate_layer_fn` builds the wrapper from the registry entry instead:
+single-output ops get a `fn(x, ..., name=None) -> Variable` that appends
+the op.  The doc decorators are kept as identity-with-annotation so
+reference code importing them keeps working.
+"""
+import functools
+import warnings
+
+from ..core import registry
+from ..core.layer_helper import LayerHelper
+
+__all__ = ['deprecated', 'generate_layer_fn', 'generate_layer_fn_noattr',
+           'autodoc', 'templatedoc']
+
+
+def deprecated(since, instead, extra_message=''):
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                '%s is deprecated since %s, use %s instead. %s'
+                % (func.__name__, since, instead, extra_message),
+                DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return wrapper
+    return decorator
+
+
+def autodoc(comment=''):
+    def decorator(func):
+        func.__doc__ = (comment + '\n' + (func.__doc__ or '')).strip()
+        return func
+    return decorator
+
+
+def templatedoc(op_type=None):
+    """The reference fills ${comment} placeholders from OpProto; there
+    is no proto, so the docstring is left as written."""
+    def decorator(func):
+        return func
+    return decorator
+
+
+def _make(op_type, single_input_slot, out_slot):
+    if not registry.has_op(op_type):
+        raise ValueError('cannot generate a layer for unregistered op %r'
+                         % op_type)
+
+    def layer_fn(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(type=op_type,
+                         inputs={single_input_slot: x},
+                         outputs={out_slot: out}, attrs=attrs)
+        return out
+
+    layer_fn.__name__ = op_type
+    layer_fn.__doc__ = ('Generated layer for the registered op %r '
+                        '(single input %r -> output %r).'
+                        % (op_type, single_input_slot, out_slot))
+    return layer_fn
+
+
+def generate_layer_fn(op_type):
+    """Build `fn(x, **attrs) -> out` for a registered single-input op
+    (reference generate_layer_fn, minus OpProto introspection: input
+    slot 'X' and output slot 'Out' by convention)."""
+    return _make(op_type, 'X', 'Out')
+
+
+def generate_layer_fn_noattr(op_type):
+    return _make(op_type, 'X', 'Out')
