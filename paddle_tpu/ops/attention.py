@@ -24,20 +24,29 @@ _LSE_LANES = 8   # trailing broadcast dim that makes (1, bq) rows tileable
 
 def _ref_attention(q, k, v, causal, scale, k_len=None):
     """q: [B, H, Tq, D]; k/v: [B, Hkv, Tk, D] with H % Hkv == 0 (GQA —
-    each kv head serves H/Hkv query heads without materializing copies)."""
+    each kv head serves H/Hkv query heads without materializing copies).
+
+    Matches the pallas kernel's precision contract under AMP: the
+    einsums run in the input dtype on the MXU but accumulate/emit f32
+    (preferred_element_type), so masking and softmax statistics are
+    always f32 even for bf16 activations; the output returns in the
+    input dtype.  For f32 inputs every step is the plain f32 path."""
     B, H, Tq, D = q.shape
     Hkv, Tk = k.shape[1], k.shape[2]
     g = H // Hkv
     qg = q.reshape(B, Hkv, g, Tq, D)
-    scores = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k) * scale
+    scores = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         mask = np.tril(np.ones((Tq, Tk), np.bool_), k=Tk - Tq)
         scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
     if k_len is not None:
         kmask = jnp.arange(Tk)[None, :] < k_len[:, None]   # [B, Tk]
         scores = jnp.where(kmask[:, None, None, None, :], scores, _NEG_INF)
-    w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum('bhgqk,bhkd->bhgqd', w, v).reshape(B, H, Tq, D)
+    w = jax.nn.softmax(scores, axis=-1)                    # f32
+    out = jnp.einsum('bhgqk,bhkd->bhgqd', w.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, H, Tq, D).astype(q.dtype)
 
 
 def _flash_kernel(klen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k,
@@ -207,6 +216,15 @@ def _flash_dkv_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 # scores.  The pallas backward's job is the regime where it can't.
 _BWD_PALLAS_SCORE_BYTES = 4 << 30
 
+# Below this key length the FORWARD also routes to the composed einsum
+# path: measured end-to-end on TPU v5 lite transformer-base training
+# (B*T = 8k tokens), composed reaches 211.8k tok/s at T=256 vs 182.1k
+# through the pallas forward (+16%) — XLA's fused batched matmuls win
+# while the T^2 scores are small — with the crossover at T=512 (146.2k
+# flash vs 145.6k composed).  `flash_attention` is fused-attention
+# SEMANTICS; the op picks the fastest lowering per shape.
+_FWD_PALLAS_MIN_T = 512
+
 
 def flash_attention(q, k, v, causal=False, scale=None, k_len=None,
                     block_q=128, block_k=128, interpret=None):
@@ -228,8 +246,9 @@ def flash_attention(q, k, v, causal=False, scale=None, k_len=None,
         k_len = jnp.full((q.shape[0],), Tk, jnp.int32)
     k_len = k_len.astype(jnp.int32)
     bq, bk = min(block_q, Tq), min(block_k, Tk)
-    if Tq % bq or Tk % bk or D % 8:
-        # shapes the kernel can't tile — composed path (jax AD backward)
+    if Tq % bq or Tk % bk or D % 8 or Tk < _FWD_PALLAS_MIN_T:
+        # shapes the kernel can't tile, or short-context sizes where the
+        # composed path measures faster — composed (jax AD backward)
         return _ref_attention(q, k, v, causal, scale, k_len)
     pallas_bwd = B * H * Tq * Tk * 2 > _BWD_PALLAS_SCORE_BYTES
 

@@ -21,9 +21,12 @@ def _squeeze_label(label):
 
 @register('cross_entropy')
 def cross_entropy(ctx, ins, attrs):
-    x, label = ins['X'], ins['Label']
+    # log/sum in f32 regardless of input dtype (AMP feeds bf16 probs);
+    # the per-row loss is always f32 so downstream reductions stay exact
+    x, label = ins['X'].astype(jnp.float32), ins['Label']
     if attrs.get('soft_label', False):
-        out = -jnp.sum(label * jnp.log(x + _EPS), axis=-1, keepdims=True)
+        out = -jnp.sum(label.astype(jnp.float32) * jnp.log(x + _EPS),
+                       axis=-1, keepdims=True)
         return {'Y': out}
     lab = _squeeze_label(label)
     picked = jnp.take_along_axis(x, lab[..., None].astype(jnp.int32),
@@ -36,11 +39,16 @@ def cross_entropy(ctx, ins, attrs):
 
 @register('softmax_with_cross_entropy')
 def softmax_with_cross_entropy(ctx, ins, attrs):
+    # logsumexp in f32 (bf16 logits under AMP are fine — the reduction is
+    # not); Loss is always f32.  The f32 [.., V] logp persists to
+    # backward as a residual; dropping it via jax.checkpoint measured
+    # 19% slower end-to-end (PERF.md), available as PT_CE_REMAT=1.
     logits, label = ins['Logits'], ins['Label']
     axis = attrs.get('axis', -1)
-    logp = jax.nn.log_softmax(logits, axis=axis)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if attrs.get('soft_label', False):
-        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis,
+                        keepdims=True)
     else:
         # label keeps a size-1 dim at `axis` (reference convention); add it
         # if the caller passed the squeezed form

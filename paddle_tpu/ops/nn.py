@@ -194,23 +194,27 @@ def batch_norm(ctx, ins, attrs):
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     bshape = [1] * x.ndim
     bshape[ch_axis] = x.shape[ch_axis]
+    # statistics always accumulate in f32 (bf16 mean/var over B*H*W
+    # elements would lose ~5 bits); y returns in the input dtype so AMP
+    # activations stay half-width in HBM
+    xf = x.astype(jnp.float32)
 
     if is_test or attrs.get('use_global_stats', False):
         m, v = mean, var
-        y = (x - m.reshape(bshape)) * (
+        y = (xf - m.reshape(bshape)) * (
             scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + eps)) + \
             bias.reshape(bshape)
-        return {'Y': y, 'MeanOut': mean, 'VarianceOut': var,
+        return {'Y': y.astype(x.dtype), 'MeanOut': mean, 'VarianceOut': var,
                 'SavedMean': m, 'SavedVariance': v}
-    m = jnp.mean(x, axis=axes)
-    v = jnp.mean(jnp.square(x - m.reshape(bshape)), axis=axes)
-    y = (x - m.reshape(bshape)) * (
+    m = jnp.mean(xf, axis=axes)
+    v = jnp.mean(jnp.square(xf - m.reshape(bshape)), axis=axes)
+    y = (xf - m.reshape(bshape)) * (
         scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + eps)) + \
         bias.reshape(bshape)
     new_mean = lax.stop_gradient(momentum * mean + (1 - momentum) * m)
     new_var = lax.stop_gradient(momentum * var + (1 - momentum) * v)
-    return {'Y': y, 'MeanOut': new_mean, 'VarianceOut': new_var,
-            'SavedMean': m, 'SavedVariance': v}
+    return {'Y': y.astype(x.dtype), 'MeanOut': new_mean,
+            'VarianceOut': new_var, 'SavedMean': m, 'SavedVariance': v}
 
 
 @register('layer_norm')
@@ -219,15 +223,16 @@ def layer_norm(ctx, ins, attrs):
     begin = attrs.get('begin_norm_axis', 1)
     eps = attrs.get('epsilon', 1e-5)
     axes = tuple(range(begin, x.ndim))
-    m = jnp.mean(x, axis=axes, keepdims=True)
-    v = jnp.mean(jnp.square(x - m), axis=axes, keepdims=True)
-    y = (x - m) * lax.rsqrt(v + eps)
+    xf = x.astype(jnp.float32)  # f32 statistics; output in input dtype
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.mean(jnp.square(xf - m), axis=axes, keepdims=True)
+    y = (xf - m) * lax.rsqrt(v + eps)
     norm_shape = x.shape[begin:]
     if 'Scale' in ins:
         y = y * ins['Scale'].reshape(norm_shape)
     if 'Bias' in ins:
         y = y + ins['Bias'].reshape(norm_shape)
-    return {'Y': y, 'Mean': m.reshape(x.shape[:begin]),
+    return {'Y': y.astype(x.dtype), 'Mean': m.reshape(x.shape[:begin]),
             'Variance': v.reshape(x.shape[:begin])}
 
 
@@ -237,7 +242,7 @@ def group_norm(ctx, ins, attrs):
     g = attrs.get('groups', 1)
     eps = attrs.get('epsilon', 1e-5)
     n, c = x.shape[0], x.shape[1]
-    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    xg = x.reshape((n, g, c // g) + x.shape[2:]).astype(jnp.float32)
     axes = tuple(range(2, xg.ndim))
     m = jnp.mean(xg, axis=axes, keepdims=True)
     v = jnp.mean(jnp.square(xg - m), axis=axes, keepdims=True)
@@ -247,7 +252,8 @@ def group_norm(ctx, ins, attrs):
         y = y * ins['Scale'].reshape(bshape)
     if 'Bias' in ins:
         y = y + ins['Bias'].reshape(bshape)
-    return {'Y': y, 'Mean': m.reshape(n, g), 'Variance': v.reshape(n, g)}
+    return {'Y': y.astype(x.dtype), 'Mean': m.reshape(n, g),
+            'Variance': v.reshape(n, g)}
 
 
 @register('data_norm')
@@ -261,12 +267,17 @@ def data_norm(ctx, ins, attrs):
 
 @register('softmax')
 def softmax(ctx, ins, attrs):
-    return {'Out': jax.nn.softmax(ins['X'], axis=attrs.get('axis', -1))}
+    x = ins['X']  # exp/sum in f32; result back in input dtype
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=attrs.get('axis', -1))
+    return {'Out': out.astype(x.dtype)}
 
 
 @register('log_softmax')
 def log_softmax(ctx, ins, attrs):
-    return {'Out': jax.nn.log_softmax(ins['X'], axis=attrs.get('axis', -1))}
+    x = ins['X']
+    out = jax.nn.log_softmax(x.astype(jnp.float32),
+                             axis=attrs.get('axis', -1))
+    return {'Out': out.astype(x.dtype)}
 
 
 @register('dropout')

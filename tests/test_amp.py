@@ -1,0 +1,150 @@
+"""bf16 auto-mixed-precision (measured split policy).
+
+Pins the executor AMP contract (core/executor.py, measurements in
+PERF.md):
+- conv-class op outputs STAY bf16 (flow-through: activations half-width
+  through CNN BN/relu/residual chains — measured +25% on ResNet-50)
+- matmul-class op outputs cast back to f32 (flow-through measured
+  slower on the transformer)
+- elementwise glue follows bf16 instead of promoting back to f32
+- norm statistics / softmax / cross-entropy compute internally in f32,
+  so the loss is f32 and finite, and training converges under AMP
+Parity: reference contrib mixed-precision era behavior
+(float16 lists in contrib docs); bf16 replaces fp16 on TPU (same
+exponent range as f32 — no loss scaling needed).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run_amp_program(build_fn, feed, fetch, steps=1):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            fetches = build_fn()
+    main.set_amp(True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            outs = exe.run(main, feed=feed, fetch_list=fetches,
+                           return_numpy=False)
+    return outs
+
+
+def test_amp_matmul_output_cast_back_f32():
+    """matmul class: computes in bf16 but the output returns f32 (the
+    cast fuses into the GEMM epilogue; measured faster than bf16
+    flow-through for transformer-shaped programs)."""
+    x = np.random.RandomState(0).rand(4, 8).astype('float32')
+
+    def build():
+        d = layers.data('x', shape=[8], dtype='float32')
+        h = layers.fc(d, 16, bias_attr=False,
+                      param_attr=fluid.ParamAttr(name='w_amp'))
+        loss = layers.reduce_mean(h)
+        return [h, loss]
+
+    h, loss = _run_amp_program(build, {'x': x}, None)
+    import jax.numpy as jnp
+    assert h.dtype == jnp.float32, h.dtype
+    assert np.isfinite(float(np.asarray(loss)))
+
+
+def test_amp_conv_output_flows_bf16():
+    """conv class: output stays bf16, and the downstream BN/relu residual
+    chain (elementwise _AMP_MATCH rule) keeps it bf16 instead of
+    promoting back to f32."""
+    x = np.random.RandomState(1).rand(2, 3, 8, 8).astype('float32')
+
+    def build():
+        d = layers.data('img', shape=[3, 8, 8], dtype='float32')
+        c = layers.conv2d(d, num_filters=4, filter_size=3, padding=1,
+                          bias_attr=False)
+        c = layers.batch_norm(c, act='relu')
+        c2 = layers.conv2d(c, num_filters=4, filter_size=3, padding=1,
+                           bias_attr=False)
+        res = layers.elementwise_add(c, c2)
+        return [c, res]
+
+    c, res = _run_amp_program(build, {'img': x}, None)
+    import jax.numpy as jnp
+    assert c.dtype == jnp.bfloat16, c.dtype
+    assert res.dtype == jnp.bfloat16, res.dtype
+
+
+def test_amp_layer_norm_stats_f32():
+    """layer_norm on a bf16 input: Y in bf16, but the normalization must
+    match an f32 reference to f32-stats accuracy (not bf16-stats)."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    rng = np.random.RandomState(2)
+    x = (rng.rand(8, 64).astype('float32') * 3 + 100).astype(
+        jnp.bfloat16)  # large mean: bf16 stats would be visibly wrong
+    outs = get_op('layer_norm').impl(
+        None, {'X': jnp.asarray(x)}, {'begin_norm_axis': 1})
+    y = np.asarray(outs['Y'], dtype='float32')
+    assert outs['Y'].dtype == jnp.bfloat16
+    assert outs['Mean'].dtype == jnp.float32
+    xf = np.asarray(x, dtype='float32')
+    ref = (xf - xf.mean(1, keepdims=True)) / np.sqrt(
+        xf.var(1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, ref, atol=2e-2)
+
+
+def test_amp_training_converges():
+    """A small conv+BN+fc classifier must still train to low loss under
+    AMP — the end-to-end guard for the whole policy."""
+    rng = np.random.RandomState(3)
+    imgs = rng.rand(16, 1, 8, 8).astype('float32')
+    lbls = (imgs.mean(axis=(1, 2, 3)) > 0.5).astype('int64').reshape(-1, 1)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            d = layers.data('img', shape=[1, 8, 8], dtype='float32')
+            lb = layers.data('lbl', shape=[1], dtype='int64')
+            c = layers.conv2d(d, num_filters=8, filter_size=3, padding=1)
+            c = layers.batch_norm(c, act='relu')
+            p = layers.pool2d(c, pool_size=8, pool_type='avg',
+                              global_pooling=True)
+            logits = layers.fc(p, 2)
+            loss = layers.reduce_mean(
+                layers.softmax_with_cross_entropy(logits, lb))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    main.set_amp(True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    feed = {'img': imgs, 'lbl': lbls}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = None
+        for i in range(60):
+            lv, = exe.run(main, feed=feed, fetch_list=[loss])
+            v = float(np.asarray(lv).ravel()[0])
+            if first is None:
+                first = v
+    assert np.isfinite(v)
+    assert v < first * 0.5, (first, v)
+
+
+def test_amp_softmax_ce_loss_is_f32():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    rng = np.random.RandomState(4)
+    logits = jnp.asarray(rng.randn(4, 10).astype('float32'),
+                         dtype=jnp.bfloat16)
+    lbl = jnp.asarray(rng.randint(0, 10, (4, 1)))
+    outs = get_op('softmax_with_cross_entropy').impl(
+        None, {'Logits': logits, 'Label': lbl}, {})
+    assert outs['Loss'].dtype == jnp.float32
+    # matches f32 computation to bf16-logit rounding only
+    lf = np.asarray(logits, dtype='float32')
+    ref = -np.take_along_axis(
+        lf - np.log(np.exp(lf).sum(-1, keepdims=True)),
+        np.asarray(lbl), axis=-1)
+    np.testing.assert_allclose(np.asarray(outs['Loss']), ref, atol=1e-3)

@@ -7,6 +7,15 @@ import jax
 from paddle_tpu.ops.attention import flash_attention, _ref_attention
 
 
+@pytest.fixture(autouse=True)
+def _force_kernel_path(monkeypatch):
+    """flash_attention routes short-T shapes to the composed path
+    (measured faster on TPU below T=512 — see ops/attention.py); these
+    are KERNEL parity tests, so force the kernel on at any size."""
+    from paddle_tpu.ops import attention as att
+    monkeypatch.setattr(att, '_FWD_PALLAS_MIN_T', 0)
+
+
 def _rand(shape, seed):
     return np.random.RandomState(seed).normal(size=shape).astype('float32')
 
@@ -62,6 +71,39 @@ def test_gradient_parity():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(a, b, atol=2e-5)
+
+
+def test_short_t_routes_to_composed_path(monkeypatch):
+    """Default dispatch (no kernel forcing): below _FWD_PALLAS_MIN_T the
+    op must lower to the composed path; at/above it, the pallas kernel.
+    Also pins the AMP precision contract on the composed route: bf16
+    in/out with f32 softmax internals (matches the kernel)."""
+    from paddle_tpu.ops import attention as att
+    monkeypatch.setattr(att, '_FWD_PALLAS_MIN_T', 512)  # the default
+    calls = []
+    real_ref = att._ref_attention
+
+    def spy(*a, **kw):
+        calls.append(a[0].shape)
+        return real_ref(*a, **kw)
+
+    monkeypatch.setattr(att, '_ref_attention', spy)
+    import jax.numpy as jnp
+    q, k, v = (jnp.asarray(_rand((1, 2, 256, 16), i), jnp.bfloat16)
+               for i in range(3))
+    out = flash_attention(q, k, v, causal=True)
+    assert len(calls) == 1, 'T=256 must route to the composed path'
+    assert out.dtype == jnp.bfloat16
+    # f32-softmax internals: close to the all-f32 reference within
+    # bf16 input-rounding error only
+    ref = real_ref(*(x.astype(jnp.float32) for x in (q, k, v)),
+                   True, 16 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out, dtype='float32'),
+                               np.asarray(ref), atol=2e-2)
+    calls.clear()
+    q2, k2, v2 = (_rand((1, 2, 512, 16), i + 3) for i in range(3))
+    flash_attention(q2, k2, v2)  # interpret-mode kernel on CPU
+    assert not calls, 'T=512 must route to the pallas kernel'
 
 
 @pytest.mark.parametrize('cfg', [
