@@ -209,12 +209,14 @@ def _flash_dkv_kernel(klen_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 # Above this many bytes of would-be score matrix (B*H*Tq*Tk*2, bf16), the
 # backward runs the blockwise pallas kernels; below it, the composed
-# einsum backward.  Measured on TPU v5 lite (B*T ~ 16k tokens, H=16,
-# D=64): composed wins at every size that fits — 5.2 vs 14.7 ms at T=256
-# up to 33.9 vs 47.2 ms at T=4096 — because XLA's big fused batched
-# matmuls beat a sequential-grid kernel whenever HBM can hold the T^2
-# scores.  The pallas backward's job is the regime where it can't.
-_BWD_PALLAS_SCORE_BYTES = 4 << 30
+# einsum backward.  Measured END-TO-END (fwd+grad, causal, B=2 H=8 D=64
+# bf16, TPU v5 lite): composed 5.7 ms vs pallas 9.0 ms at T=2048
+# (134 MB scores), pallas 16.1 vs 18.7 at T=4096 (537 MB), pallas 44 ms
+# vs composed 486 ms at T=8192 (2.1 GB — XLA starts thrashing HBM long
+# before the hard capacity wall).  Crossover ~T=4096, so the gate sits
+# at 256 MiB of bf16 scores; the pallas kernels own the long-context
+# regime, XLA's fused batched matmuls own the short one.
+_BWD_PALLAS_SCORE_BYTES = 256 << 20
 
 # Below this key length the FORWARD also routes to the composed einsum
 # path: measured end-to-end on TPU v5 lite transformer-base training
