@@ -112,12 +112,29 @@ def test_short_t_routes_to_composed_path(monkeypatch):
     dict(B=2, H=8, Hkv=2, Tq=128, Tk=128, D=32, causal=True, klen=False),
     dict(B=2, H=8, Hkv=2, Tq=128, Tk=256, D=32, causal=True, klen=True),
 ])
-def test_pallas_backward_kernels_gradient_parity(cfg, monkeypatch):
+@pytest.mark.parametrize('dkv_variant', ['resident', 'streamed'])
+def test_pallas_backward_kernels_gradient_parity(cfg, dkv_variant,
+                                                 monkeypatch):
     """The pallas dq/dkv kernels normally engage only above the HBM score
     threshold (long-T); force them on so regressions surface here, not on
-    a long-sequence TPU run."""
+    a long-sequence TPU run.  Both dK/dV variants are exercised: the
+    VMEM-resident register-accumulation one (short Tq) and the q-streaming
+    4-D-grid one (long Tq)."""
     from paddle_tpu.ops import attention as att
     monkeypatch.setattr(att, '_BWD_PALLAS_SCORE_BYTES', 0)
+    if dkv_variant == 'streamed':
+        monkeypatch.setattr(att, '_DKV_RESIDENT_MAX_T', 0)
+    # guard against the gates silently vacating this test (it happened:
+    # _FWD_PALLAS_MIN_T was added after this test and routed its shapes
+    # away from the kernels until the autouse fixture above restored them)
+    engaged = {}
+    real_bwd = att._flash_backward
+
+    def spy_bwd(*a, **kw):
+        engaged['bwd'] = True
+        return real_bwd(*a, **kw)
+
+    monkeypatch.setattr(att, '_flash_backward', spy_bwd)
     rng = np.random.RandomState(9)
     B, H, Hkv, Tq, Tk, D = (cfg[k] for k in 'B H Hkv Tq Tk D'.split())
     q = rng.randn(B, H, Tq, D).astype('float32')
@@ -136,5 +153,7 @@ def test_pallas_backward_kernels_gradient_parity(cfg, monkeypatch):
 
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert engaged.get('bwd'), \
+        'pallas backward never engaged — a routing gate vacated this test'
     for a, b, n in zip(gf, gr, 'dq dk dv'.split()):
         np.testing.assert_allclose(a, b, atol=5e-4, err_msg=n)
