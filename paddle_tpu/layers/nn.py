@@ -1342,9 +1342,38 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=
             None):
-    raise NotImplementedError(
-        'py_func executes arbitrary Python inside the graph; under XLA use '
-        'jax.pure_callback via paddle_tpu.ops registration instead')
+    """Run a Python callable as an op (parity: reference nn.py py_func /
+    py_func_op.cc).  `out` variables are pre-created by the caller with
+    their shapes/dtypes, exactly as in the reference.
+
+    TPU-native lowering: the callable runs on the HOST via
+    jax.pure_callback inside the one jitted step (XLA inserts the
+    device<->host transfers); `backward_func`, if given, becomes the
+    custom VJP and receives (inputs..., outputs..., out-grads...) minus
+    `skip_vars_in_backward_input`, returning one grad per input.  The
+    callable must be functionally pure — it can be retraced, cached, or
+    re-run by XLA like any other op."""
+    helper = LayerHelper('py_func')
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        if o.shape is None:
+            raise ValueError(
+                'py_func out var %r has no shape: XLA needs static output '
+                'shapes, so create it with create_parameter/create_'
+                'global_var or set var.shape (use -1 for the batch dim)'
+                % o.name)
+    skip = skip_vars_in_backward_input or []
+    skip_names = {getattr(v, 'name', v) for v in
+                  (skip if isinstance(skip, (list, tuple)) else [skip])}
+    skip_idx = [i for i, v in enumerate(xs + outs) if v.name in skip_names]
+    helper.append_op(
+        type='py_func', inputs={'X': xs}, outputs={'Out': outs},
+        attrs={'func': func, 'backward_func': backward_func,
+               'skip_bwd_idx': skip_idx,
+               'out_shapes': [list(o.shape) for o in outs],
+               'out_dtypes': [o.dtype for o in outs]})
+    return out
 
 
 # ------------------------------------------------------- sequence family

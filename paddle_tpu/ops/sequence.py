@@ -208,8 +208,33 @@ def sequence_scatter(ctx, ins, attrs):
 
 @register('sequence_erase')
 def sequence_erase(ctx, ins, attrs):
-    raise NotImplementedError(
-        'sequence_erase produces data-dependent lengths; mask tokens instead')
+    """Remove the attr `tokens` from each sequence (parity: reference
+    sequence_erase_op.cc).  Data-dependent lengths are handled with
+    static shapes: kept tokens compact left via a stable argsort on
+    (erased?, position), the tail zero-fills, and the new per-row
+    lengths come back in the Length slot — the padded+lengths analog of
+    the reference's shrinking LoD."""
+    x = ins['X']  # [B, T] or [B, T, 1] int tokens
+    tokens = attrs.get('tokens', [])
+    length = _length_or_full(ins, x)
+    squeeze = x.ndim == 3
+    ids = x[..., 0] if squeeze else x
+    B, T = ids.shape
+    t = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    valid = t < length[:, None]
+    erased = jnp.zeros_like(valid)
+    for tok in tokens:
+        erased = erased | (ids == tok)
+    keep = valid & ~erased
+    # kept tokens sort before dropped ones, original order preserved
+    order = jnp.argsort(jnp.where(keep, t, t + T), axis=1)
+    compacted = jnp.take_along_axis(ids, order, axis=1)
+    new_len = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(t < new_len[:, None], compacted,
+                    jnp.zeros_like(compacted))
+    if squeeze:
+        out = out[..., None]
+    return {'Out': out, 'Length': new_len}
 
 
 # --------------------------------------------------------------- RNNs

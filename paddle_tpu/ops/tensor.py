@@ -328,6 +328,76 @@ def where_index(ctx, ins, attrs):
         'where_index has data-dependent output shape; not XLA-compatible')
 
 
+@register('py_func')
+def py_func_op(ctx, ins, attrs):
+    """Host-callback op (parity: reference py_func_op.cc).  The Python
+    callable runs on the host inside the jitted step via
+    jax.pure_callback; backward_func becomes a custom VJP that also runs
+    as a host callback.  Callables must be pure (XLA may re-run them)."""
+    xs = ins['X']
+    xs = list(xs) if isinstance(xs, (list, tuple)) else [xs]
+    func = attrs['func']
+    bwd = attrs.get('backward_func')
+    # canonicalize (int64 -> int32 etc. without x64), like jnp ops do
+    dtypes = [jax.dtypes.canonicalize_dtype(np.dtype(convert_dtype(d)))
+              for d in attrs['out_dtypes']]
+    batch = xs[0].shape[0] if xs and getattr(xs[0], 'ndim', 0) else 1
+    result = tuple(
+        jax.ShapeDtypeStruct(tuple(batch if s == -1 else s for s in shp), d)
+        for shp, d in zip(attrs['out_shapes'], dtypes))
+
+    def host_fwd(*arrays):
+        r = func(*[np.asarray(a) for a in arrays])
+        r = list(r) if isinstance(r, (list, tuple)) else [r]
+        return tuple(np.asarray(v).astype(d) for v, d in zip(r, dtypes))
+
+    if bwd is None:
+        # reference semantics without backward_func: no grad propagates
+        outs = jax.pure_callback(
+            host_fwd, result, *[lax.stop_gradient(x) for x in xs])
+        return {'Out': list(outs)}
+
+    skip = set(attrs.get('skip_bwd_idx', ()))
+
+    float_pos = [i for i, x in enumerate(xs)
+                 if jnp.issubdtype(x.dtype, jnp.floating)]
+    float_xs = [xs[i] for i in float_pos]
+
+    def host_bwd(*arrays):
+        # backward_func returns one grad per input (reference contract);
+        # only the float ones are consumed
+        r = bwd(*[np.asarray(a) for a in arrays])
+        r = list(r) if isinstance(r, (list, tuple)) else [r]
+        return tuple(np.asarray(r[i]).astype(xs[i].dtype)
+                     for i in float_pos)
+
+    @jax.custom_vjp
+    def call(*args):
+        return jax.pure_callback(host_fwd, result, *args)
+
+    def call_fwd(*args):
+        outs = jax.pure_callback(host_fwd, result, *args)
+        return outs, (args, outs)
+
+    def call_bwd(res, g):
+        args, outs = res
+        bwd_in = [a for i, a in enumerate(list(args) + list(outs))
+                  if i not in skip] + list(g)
+        dx_shape = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype)
+                         for x in float_xs)
+        dxs = list(jax.pure_callback(host_bwd, dx_shape, *bwd_in))
+        full = []
+        for x in args:
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                full.append(dxs.pop(0))
+            else:  # integer inputs get symbolic-zero cotangents
+                full.append(np.zeros(x.shape, jax.dtypes.float0))
+        return tuple(full)
+
+    call.defvjp(call_fwd, call_bwd)
+    return {'Out': list(call(*xs))}
+
+
 @register('hash')
 def hash_op(ctx, ins, attrs):
     x = ins['X'].astype(jnp.int64)

@@ -344,3 +344,80 @@ def test_op_gradients_vs_numeric_diff(case):
         np.testing.assert_allclose(
             g, num, rtol=5e-2, atol=5e-3,
             err_msg='%s grad wrt %s' % (op_type, name))
+
+
+def test_py_func_forward_and_backward():
+    """py_func: host callable as an op (pure_callback lowering), with a
+    backward_func-driven custom VJP reaching the parameter gradients."""
+    import paddle_tpu as fluid
+
+    def double_plus(a):
+        return a * 2.0 + 1.0
+
+    def double_plus_bwd(a, out, dout):
+        return dout * 2.0
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            d = layers.data('x', shape=[3], dtype='float32')
+            w = layers.create_parameter([3, 3], 'float32', name='pyf_w')
+            h = layers.matmul(d, w)
+            out_var = layers.create_tensor('float32', name='pyf_out')
+            out_var.shape = (-1, 3)
+            layers.py_func(double_plus, h, out_var,
+                           backward_func=double_plus_bwd)
+            loss = layers.reduce_mean(out_var)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.ones((2, 3), 'float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get('pyf_w')).copy()
+        l1, o1 = exe.run(main, feed={'x': xv}, fetch_list=[loss, out_var])
+        w1 = np.asarray(scope.get('pyf_w'))
+    np.testing.assert_allclose(o1, xv @ w0 * 2.0 + 1.0, rtol=1e-5)
+    # dL/dw = x^T @ (dout * 2) with dout = 1/6
+    ref_gw = xv.T @ (np.full((2, 3), 2.0 / 6.0, 'float32'))
+    np.testing.assert_allclose(w1, w0 - 0.5 * ref_gw, rtol=1e-4)
+
+
+def test_py_func_no_backward_cuts_gradient():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            d = layers.data('x', shape=[3], dtype='float32')
+            w = layers.create_parameter([3, 3], 'float32', name='pyf2_w')
+            h = layers.matmul(d, w)
+            out_var = layers.create_tensor('float32', name='pyf2_out')
+            out_var.shape = (-1, 3)
+            layers.py_func(lambda a: a + 1.0, h, out_var)
+            loss = layers.reduce_mean(out_var)
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.get('pyf2_w')).copy()
+        exe.run(main, feed={'x': np.ones((2, 3), 'float32')},
+                fetch_list=[loss])
+        w1 = np.asarray(scope.get('pyf2_w'))
+    np.testing.assert_allclose(w1, w0)  # gradient cut: no update
+
+
+def test_sequence_erase_compacts_and_relengths():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    ids = jnp.asarray([[3, 5, 3, 7, 0, 0],
+                       [5, 5, 5, 1, 2, 9]])
+    lens = jnp.asarray([4, 6], jnp.int32)
+    outs = get_op('sequence_erase').impl(
+        None, {'X': ids, 'Length': lens}, {'tokens': [3, 5]})
+    np.testing.assert_array_equal(
+        np.asarray(outs['Out']),
+        [[7, 0, 0, 0, 0, 0],   # row0 [3,5,3,7]: erase 3s and 5s -> [7]
+         [1, 2, 9, 0, 0, 0]])  # row1: erase 5s -> [1, 2, 9]
+    np.testing.assert_array_equal(np.asarray(outs['Length']), [1, 3])
