@@ -1473,10 +1473,13 @@ def sequence_unpad(x, length, name=None):
 def sequence_slice(input, offset, length, name=None):
     helper = LayerHelper('sequence_slice', name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference('int32')
     helper.append_op(type='sequence_slice',
                      inputs={'X': input, 'Offset': offset, 'Length': length},
-                     outputs={'Out': out}, attrs={})
-    _copy_lod(input, out)
+                     outputs={'Out': out, 'OutLength': out_len}, attrs={})
+    # the output sequence's lengths are the requested slice lengths
+    out.lod_level = max(input.lod_level, 1)
+    out.lod_length_name = out_len.name
     return out
 
 
@@ -1519,11 +1522,30 @@ def sequence_enumerate(input, win_size, pad_value=0, name=None):
 
 
 def sequence_concat(input, name=None):
+    """Row-wise sequence concat: row i of the result is input0's row-i
+    tokens followed by input1's row-i tokens (contiguous), length =
+    sum of lengths.  Parity: reference sequence_concat (nn.py) /
+    sequence_concat_op.cc."""
     helper = LayerHelper('sequence_concat', name=name)
-    out = helper.create_variable_for_type_inference(input[0].dtype)
-    helper.append_op(type='sequence_concat', inputs={'X': input},
-                     outputs={'Out': out}, attrs={})
-    _copy_lod(input[0], out)
+    xs = list(input)
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    out_len = helper.create_variable_for_type_inference('int32')
+    ins = {'X': xs}
+    lvs = [_len_var(x) for x in xs]
+    if any(lv is not None for lv in lvs):
+        from .tensor import fill_constant_batch_size_like
+        lens = []
+        for x, lv in zip(xs, lvs):
+            if lv is None:  # dense input: every row is full length
+                lens.append(fill_constant_batch_size_like(
+                    x, [-1], 'int32', float(x.shape[1])))
+            else:
+                lens.append(lv)
+        ins['Length'] = lens
+    helper.append_op(type='sequence_concat', inputs=ins,
+                     outputs={'Out': out, 'OutLength': out_len}, attrs={})
+    out.lod_level = max(max(x.lod_level for x in xs), 1)
+    out.lod_length_name = out_len.name
     return out
 
 

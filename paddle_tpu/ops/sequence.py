@@ -159,16 +159,45 @@ def sequence_slice(ctx, ins, attrs):
     idx = jnp.minimum(off[:, None] + t, T - 1)
     out = jnp.take_along_axis(
         x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
-    m = (t < length.reshape(-1)[:, None]).reshape(
+    new_len = length.reshape(-1).astype(jnp.int32)
+    m = (t < new_len[:, None]).reshape(
         (x.shape[0], T) + (1,) * (x.ndim - 2))
-    return {'Out': out * m.astype(x.dtype)}
+    # the output's lengths are the REQUESTED slice lengths, not X's
+    return {'Out': out * m.astype(x.dtype), 'OutLength': new_len}
 
 
 @register('sequence_concat')
 def sequence_concat(ctx, ins, attrs):
+    """Concatenate sequences ROW-WISE (parity: reference
+    sequence_concat_op): row i of the output is input0's valid tokens
+    then input1's valid tokens, contiguous, with length = sum of the
+    per-input lengths.  In the padded layout that means compacting the
+    concatenated padded blocks left (stable argsort on validity), not
+    just stacking them — stacking would leave pad holes between rows'
+    valid segments."""
     xs = ins['X']
     xs = xs if isinstance(xs, (list, tuple)) else [xs]
-    return {'Out': jnp.concatenate(xs, axis=1)}
+    lens = ins.get('Length')
+    combined = jnp.concatenate(xs, axis=1)           # [B, sum T, ...]
+    B, T = combined.shape[:2]
+    if lens is None:
+        return {'Out': combined,
+                'OutLength': jnp.full((B,), T, jnp.int32)}
+    lens = lens if isinstance(lens, (list, tuple)) else [lens]
+    masks = [jnp.arange(x.shape[1])[None, :] <
+             l.reshape(-1).astype(jnp.int32)[:, None]
+             for x, l in zip(xs, lens)]
+    valid = jnp.concatenate(masks, axis=1)           # [B, sum T]
+    t = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    order = jnp.argsort(jnp.where(valid, t, t + T), axis=1)
+    out = jnp.take_along_axis(
+        combined, order.reshape(order.shape + (1,) * (combined.ndim - 2)),
+        axis=1)
+    new_len = valid.sum(axis=1).astype(jnp.int32)
+    tail = (t < new_len[:, None]).reshape(
+        (B, T) + (1,) * (combined.ndim - 2))
+    return {'Out': jnp.where(tail, out, jnp.zeros_like(out)),
+            'OutLength': new_len}
 
 
 @register('sequence_enumerate')

@@ -160,3 +160,94 @@ def test_lstm_trains_sequence_classification():
         l, = exe.run(feed={'x': xv, 'label': yv}, fetch_list=[loss])
         losses.append(float(l[0]))
     assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.8
+
+
+def test_sequence_expand_as_and_concat():
+    x = layers.data('x', shape=[2], dtype='float32')
+    y = layers.data('y', shape=[1], dtype='float32', lod_level=1)
+    ea = layers.sequence_expand_as(x, y)
+    a = layers.data('a', shape=[1], dtype='float32', lod_level=1)
+    cc = layers.sequence_concat([a, a])
+    # downstream consumer: ragged row 1 ([4,5] ++ [4,5]) must pool as a
+    # CONTIGUOUS length-4 sequence — this is where a naive padded-block
+    # concat (pad holes between the two segments, stale LoD) breaks
+    pooled = layers.sequence_pool(cc, 'sum')
+    last = layers.sequence_pool(cc, 'last')
+    exe = fluid.Executor()
+    feed_y = _lod_feed()
+    out, cat, s, lv = exe.run(
+        feed={'x': np.array([[1, 2], [3, 4]], 'float32'),
+              'y': feed_y, 'a': feed_y},
+        fetch_list=[ea, cc, pooled, last])
+    # each row of x repeats along y's time axis
+    np.testing.assert_allclose(out[0, 0], [1, 2])
+    np.testing.assert_allclose(out[0, 2], [1, 2])
+    np.testing.assert_allclose(out[1, 1], [3, 4])
+    # concat along time: [B, T1+T2, D], rows compacted left
+    assert cat.shape == (2, 6, 1)
+    np.testing.assert_allclose(cat[0, :, 0], [1, 2, 3, 1, 2, 3])
+    np.testing.assert_allclose(cat[1, :, 0], [4, 5, 4, 5, 0, 0])
+    np.testing.assert_allclose(s, [[12.], [18.]])
+    np.testing.assert_allclose(lv, [[3.], [5.]])
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    pv = layers.assign(np.zeros((1,), 'float32'))
+    padded, length = layers.sequence_pad(x, pv)
+    back = layers.sequence_unpad(padded, length)
+    pooled = layers.sequence_pool(back, 'sum')  # consumes restored LoD
+    exe = fluid.Executor()
+    p, l, s = exe.run(feed={'x': _lod_feed()},
+                      fetch_list=[padded, length, pooled])
+    assert p.shape == (2, 3, 1)
+    np.testing.assert_array_equal(l, [3, 2])
+    np.testing.assert_allclose(s, [[6.], [9.]])
+
+
+def test_sequence_slice_and_reshape():
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    off = layers.data('off', shape=[1], dtype='int64')
+    ln = layers.data('ln', shape=[1], dtype='int64')
+    sl = layers.sequence_slice(x, off, ln)
+    # downstream consumer pins that sl carries the REQUESTED lengths
+    # ([2,1]), not x's ([3,2]): average divides by 2/1, last picks the
+    # final VALID token, not a pad slot
+    avg = layers.sequence_pool(sl, 'average')
+    last = layers.sequence_pool(sl, 'last')
+    r = layers.data('r', shape=[2], dtype='float32', lod_level=1)
+    rs = layers.sequence_reshape(r, new_dim=1)
+    exe = fluid.Executor()
+    rows = [np.array([[1., 10.], [2., 20.]], 'float32')]
+    sv, av, lv, rv = exe.run(feed={'x': _lod_feed(),
+                                   'off': np.array([[1], [0]], 'int64'),
+                                   'ln': np.array([[2], [1]], 'int64'),
+                                   'r': create_lod_tensor(rows)},
+                             fetch_list=[sl, avg, last, rs])
+    # row0 [1,2,3] offset1 len2 -> [2,3]; row1 [4,5] offset0 len1 -> [4]
+    np.testing.assert_allclose(sv[0, :2, 0], [2, 3])
+    np.testing.assert_allclose(sv[1, 0, 0], 4)
+    np.testing.assert_allclose(av, [[2.5], [4.]])
+    np.testing.assert_allclose(lv, [[3.], [4.]])
+    # reshape [1 row, T=2, D=2] -> [1, 4, 1]
+    assert rv.shape == (1, 4, 1)
+    np.testing.assert_allclose(rv[0, :, 0], [1, 10, 2, 20])
+
+
+def test_sequence_enumerate_and_scatter():
+    ids = layers.data('ids', shape=[4], dtype='int64')
+    en = layers.sequence_enumerate(ids, win_size=2, pad_value=0)
+    base = layers.data('base', shape=[5], dtype='float32')
+    sidx = layers.data('sidx', shape=[3], dtype='int64')
+    upd = layers.data('upd', shape=[3], dtype='float32')
+    sc = layers.sequence_scatter(base, sidx, upd)
+    exe = fluid.Executor()
+    ev, scv = exe.run(
+        feed={'ids': np.array([[1, 2, 3, 4]], 'int64'),
+              'base': np.ones((1, 5), 'float32'),
+              'sidx': np.array([[0, 2, 4]], 'int64'),
+              'upd': np.array([[10., 20., 30.]], 'float32')},
+        fetch_list=[en, sc])
+    np.testing.assert_array_equal(
+        ev[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
+    np.testing.assert_allclose(scv[0], [11., 1., 21., 1., 31.])
