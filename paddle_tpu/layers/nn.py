@@ -1486,9 +1486,13 @@ def sequence_slice(input, offset, length, name=None):
 def sequence_reshape(input, new_dim):
     helper = LayerHelper('sequence_reshape')
     out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op(type='sequence_reshape', inputs={'X': input},
-                     outputs={'Out': out}, attrs={'new_dim': new_dim})
-    _copy_lod(input, out)
+    out_len = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='sequence_reshape', inputs=_seq_inputs(input),
+                     outputs={'Out': out, 'OutLength': out_len},
+                     attrs={'new_dim': new_dim})
+    # lengths rescale by D/new_dim, so bind the op's recomputed lengths
+    out.lod_level = max(input.lod_level, 1)
+    out.lod_length_name = out_len.name
     return out
 
 

@@ -222,7 +222,13 @@ def sequence_reshape(ctx, ins, attrs):
     x = ins['X']  # [B, T, D]
     new_dim = attrs['new_dim']
     B, T, D = x.shape
-    return {'Out': x.reshape(B, T * D // new_dim, new_dim)}
+    # suffix padding keeps each row's valid data contiguous through the
+    # flatten, so only the LENGTHS rescale: l tokens of width D become
+    # l*D/new_dim tokens of width new_dim (reference sequence_reshape_op)
+    length = _length_or_full(ins, x)
+    new_len = (length.astype(jnp.int32) * D) // new_dim
+    return {'Out': x.reshape(B, T * D // new_dim, new_dim),
+            'OutLength': new_len}
 
 
 @register('sequence_scatter')

@@ -217,21 +217,29 @@ def test_sequence_slice_and_reshape():
     last = layers.sequence_pool(sl, 'last')
     r = layers.data('r', shape=[2], dtype='float32', lod_level=1)
     rs = layers.sequence_reshape(r, new_dim=1)
+    # ragged rows: lengths rescale by D/new_dim (row lens [2,1] -> [4,2])
+    rsum = layers.sequence_pool(rs, 'sum')
+    rlast = layers.sequence_pool(rs, 'last')
     exe = fluid.Executor()
-    rows = [np.array([[1., 10.], [2., 20.]], 'float32')]
-    sv, av, lv, rv = exe.run(feed={'x': _lod_feed(),
-                                   'off': np.array([[1], [0]], 'int64'),
-                                   'ln': np.array([[2], [1]], 'int64'),
-                                   'r': create_lod_tensor(rows)},
-                             fetch_list=[sl, avg, last, rs])
+    rows = [np.array([[1., 10.], [2., 20.]], 'float32'),
+            np.array([[3., 30.]], 'float32')]
+    sv, av, lv, rv, rsv, rlv = exe.run(
+        feed={'x': _lod_feed(),
+              'off': np.array([[1], [0]], 'int64'),
+              'ln': np.array([[2], [1]], 'int64'),
+              'r': create_lod_tensor(rows)},
+        fetch_list=[sl, avg, last, rs, rsum, rlast])
     # row0 [1,2,3] offset1 len2 -> [2,3]; row1 [4,5] offset0 len1 -> [4]
     np.testing.assert_allclose(sv[0, :2, 0], [2, 3])
     np.testing.assert_allclose(sv[1, 0, 0], 4)
     np.testing.assert_allclose(av, [[2.5], [4.]])
     np.testing.assert_allclose(lv, [[3.], [4.]])
-    # reshape [1 row, T=2, D=2] -> [1, 4, 1]
-    assert rv.shape == (1, 4, 1)
+    # reshape [2 rows, T=2, D=2] -> [2, 4, 1]; row lens [2,1] -> [4,2]
+    assert rv.shape == (2, 4, 1)
     np.testing.assert_allclose(rv[0, :, 0], [1, 10, 2, 20])
+    np.testing.assert_allclose(rv[1, :2, 0], [3, 30])
+    np.testing.assert_allclose(rsv, [[33.], [33.]])
+    np.testing.assert_allclose(rlv, [[20.], [30.]])
 
 
 def test_sequence_enumerate_and_scatter():
