@@ -111,12 +111,18 @@ def embedding_gather(w, idx):
     shapes qualify; falls back to jnp.take otherwise (trace-time
     failures only — see _eligible for the compile-time kill-switch)."""
     idx_flat = idx.reshape(-1).astype(jnp.int32)
-    # match jnp.take's TPU out-of-bounds semantics (clamp): the DMA
-    # kernel would otherwise read unchecked HBM addresses for OOV ids
-    idx_flat = jnp.clip(idx_flat, 0, w.shape[0] - 1)
     if _eligible(w, idx_flat):
+        # match jnp.take's semantics exactly: negative ids wrap (numpy
+        # style), truly out-of-range ids fill with NaN (so corruption
+        # SURFACES via executor check_nan).  The raw DMA would read
+        # unchecked HBM addresses for either.
+        V = w.shape[0]
+        wrapped = jnp.where(idx_flat < 0, idx_flat + V, idx_flat)
+        oob = (wrapped < 0) | (wrapped >= V)
+        safe = jnp.clip(wrapped, 0, V - 1)
         try:
-            out = _kernel_gather(w, idx_flat)
+            out = _kernel_gather(w, safe)
+            out = jnp.where(oob[:, None], jnp.nan, out)
             return out.reshape(tuple(idx.shape) + (w.shape[1],))
         except Exception as e:  # pragma: no cover - backend-specific
             global _warned

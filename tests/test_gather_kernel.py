@@ -45,13 +45,17 @@ def test_gather_multi_dim_ids_and_fallback():
                                np.asarray(w)[[3, 1]], rtol=1e-6)
 
 
-def test_gather_oob_ids_clamp_like_take():
-    """Out-of-range ids must clamp (jnp.take's TPU semantics), not read
-    unchecked HBM addresses."""
+def test_gather_oob_ids_nan_fill_like_take():
+    """Out-of-range ids must NaN-fill (jnp.take's default OOB
+    semantics, which check_nan surfaces), not read unchecked HBM
+    addresses."""
     rng = np.random.RandomState(2)
     w = jnp.asarray(rng.randn(64, 128), jnp.float32)
     idx = np.asarray(rng.randint(0, 64, (_BLOCK,)), np.int32)
-    idx[0], idx[1] = 1000, -5  # OOV / corrupt ids
+    idx[0], idx[1] = 1000, -5  # OOV fills NaN; -5 wraps to row 59
     out = embedding_gather(w, jnp.asarray(idx))
-    ref = jnp.take(w, jnp.asarray(idx), axis=0)  # clamps on TPU/CPU
+    ref = jnp.take(w, jnp.asarray(idx), axis=0)
+    assert np.isnan(np.asarray(out)[0]).all()
+    np.testing.assert_allclose(np.asarray(out)[1], np.asarray(w)[59],
+                               rtol=1e-6)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6)
