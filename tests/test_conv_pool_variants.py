@@ -1,0 +1,151 @@
+"""Conv/pool/matmul attribute-variant numerics vs torch (CPU) as an
+independent oracle (model: reference unittests test_conv2d_op.py's
+attribute grid: strides/pads/dilations/groups, pool exclusive/ceil)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from paddle_tpu import layers
+from test_layers import _run
+
+
+def _np(x):
+    return np.asarray(x, dtype='float32')
+
+
+@pytest.mark.parametrize('cfg', [
+    dict(stride=1, pad=1, dil=1, groups=1),
+    dict(stride=2, pad=1, dil=1, groups=1),
+    dict(stride=1, pad=2, dil=2, groups=1),
+    dict(stride=1, pad=1, dil=1, groups=2),
+    dict(stride=1, pad=1, dil=1, groups=4),   # depthwise (C=4)
+], ids=lambda c: 's%dp%dd%dg%d' % (c['stride'], c['pad'], c['dil'],
+                                   c['groups']))
+def test_conv2d_variants_vs_torch(cfg):
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 4, 8, 8).astype('float32')
+    x = layers.data('x', shape=[4, 8, 8], dtype='float32')
+    out = layers.conv2d(x, num_filters=8, filter_size=3,
+                        stride=cfg['stride'], padding=cfg['pad'],
+                        dilation=cfg['dil'], groups=cfg['groups'],
+                        bias_attr=False, act=None,
+                        param_attr=None)
+    res, = _run([out], {'x': xv})
+    # oracle: torch conv2d driven with the SAME initialized filter,
+    # pulled from the scope the program ran in
+    import paddle_tpu as fluid
+    w = np.asarray(fluid.global_scope().get(
+        [p.name for p in
+         fluid.default_main_program().global_block().all_parameters()][0]))
+    ref = F.conv2d(torch.from_numpy(xv), torch.from_numpy(w), None,
+                   stride=cfg['stride'], padding=cfg['pad'],
+                   dilation=cfg['dil'], groups=cfg['groups']).numpy()
+    np.testing.assert_allclose(res, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_grad_vs_torch():
+    """Grouped+dilated conv gradient (input and filter) vs torch
+    autograd."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    impl = get_op('conv2d').impl
+    rng = np.random.RandomState(1)
+    xv = rng.randn(2, 4, 6, 6).astype('float32')
+    wv = rng.randn(6, 2, 3, 3).astype('float32')   # groups=2
+    attrs = {'strides': [1, 1], 'paddings': [1, 1], 'dilations': [2, 2],
+             'groups': 2}
+
+    def loss(x, w):
+        return (impl(None, {'Input': x, 'Filter': w}, attrs)['Output']
+                ** 2).sum()
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(jnp.asarray(xv),
+                                            jnp.asarray(wv))
+    tx = torch.from_numpy(xv).requires_grad_(True)
+    tw = torch.from_numpy(wv).requires_grad_(True)
+    (F.conv2d(tx, tw, None, stride=1, padding=1, dilation=2,
+              groups=2) ** 2).sum().backward()
+    np.testing.assert_allclose(_np(gx), tx.grad.numpy(), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(_np(gw), tw.grad.numpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_conv3d_vs_torch():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    impl = get_op('conv3d').impl
+    rng = np.random.RandomState(2)
+    xv = rng.randn(1, 3, 5, 6, 6).astype('float32')
+    wv = rng.randn(4, 3, 3, 3, 3).astype('float32')
+    out = impl(None, {'Input': jnp.asarray(xv), 'Filter': jnp.asarray(wv)},
+               {'strides': [1, 2, 1], 'paddings': [1, 1, 0]})['Output']
+    ref = F.conv3d(torch.from_numpy(xv), torch.from_numpy(wv), None,
+                   stride=(1, 2, 1), padding=(1, 1, 0)).numpy()
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_transpose_vs_torch():
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    impl = get_op('conv2d_transpose').impl
+    rng = np.random.RandomState(3)
+    xv = rng.randn(2, 4, 5, 5).astype('float32')
+    wv = rng.randn(4, 3, 3, 3).astype('float32')   # [in, out, kh, kw]
+    out = impl(None, {'Input': jnp.asarray(xv), 'Filter': jnp.asarray(wv)},
+               {'strides': [2, 2], 'paddings': [1, 1]})['Output']
+    ref = F.conv_transpose2d(torch.from_numpy(xv), torch.from_numpy(wv),
+                             None, stride=2, padding=1).numpy()
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize('cfg', [
+    dict(ptype='max', pad=1, exclusive=True, ceil=False),
+    dict(ptype='avg', pad=1, exclusive=True, ceil=False),
+    dict(ptype='avg', pad=1, exclusive=False, ceil=False),
+    dict(ptype='avg', pad=0, exclusive=True, ceil=False),
+    dict(ptype='max', pad=0, exclusive=True, ceil=True),
+    dict(ptype='max', pad=1, exclusive=True, ceil=True),
+], ids=lambda c: '%s_p%d_ex%d_c%d' % (c['ptype'], c['pad'],
+                                      c['exclusive'], c['ceil']))
+def test_pool2d_variants_vs_torch(cfg):
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    impl = get_op('pool2d').impl
+    rng = np.random.RandomState(4)
+    xv = rng.randn(2, 3, 8, 8).astype('float32')
+    out = impl(None, {'X': jnp.asarray(xv)},
+               {'ksize': [3, 3], 'strides': [2, 2],
+                'paddings': [cfg['pad'], cfg['pad']],
+                'pooling_type': cfg['ptype'],
+                'exclusive': cfg['exclusive'],
+                'ceil_mode': cfg['ceil']})['Out']
+    t = torch.from_numpy(xv)
+    if cfg['ptype'] == 'max':
+        ref = F.max_pool2d(t, 3, stride=2, padding=cfg['pad'],
+                           ceil_mode=cfg['ceil']).numpy()
+    else:
+        # reference 'exclusive' == torch count_include_pad=False
+        ref = F.avg_pool2d(t, 3, stride=2, padding=cfg['pad'],
+                           count_include_pad=not cfg['exclusive'],
+                           ceil_mode=cfg['ceil']).numpy()
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize('tx,ty', [(False, False), (True, False),
+                                   (False, True), (True, True)])
+def test_matmul_transpose_variants(tx, ty):
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import get_op
+    impl = get_op('matmul').impl
+    rng = np.random.RandomState(5)
+    a = rng.randn(2, 3, 4).astype('float32')
+    b = rng.randn(2, 4, 5).astype('float32')
+    av = a.transpose(0, 2, 1) if tx else a
+    bv = b.transpose(0, 2, 1) if ty else b
+    out = impl(None, {'X': jnp.asarray(av), 'Y': jnp.asarray(bv)},
+               {'transpose_X': tx, 'transpose_Y': ty})['Out']
+    ref = np.matmul(a, b)
+    np.testing.assert_allclose(_np(out), ref, rtol=1e-5, atol=1e-6)
