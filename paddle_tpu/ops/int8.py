@@ -2,10 +2,16 @@
 
 Parity: the reference's int8 deployment path runs conv/fc on MKLDNN int8
 kernels after contrib/int8_inference calibration.  The TPU analog feeds
-the MXU int8×int8→int32 directly (2× the bf16 rate on v5e/v6e):
-activations quantize at their calibrated scale in-graph, weights are the
-int8 arrays Calibrator/QuantizeTranspiler packed, and the int32
-accumulator dequantizes by (x_scale · w_scale / 127²).
+the MXU int8×int8→int32 directly: activations quantize at their
+calibrated scale in-graph, weights are the int8 arrays
+Calibrator/QuantizeTranspiler packed, and the int32 accumulator
+dequantizes by (x_scale · w_scale / 127²).
+
+Measured (TPU v5 lite, 8192×4096×4096 GEMM): int8 2.88 ms vs bf16
+3.58 ms — **1.24×**, well short of the 2× the int8 spec sheet implies;
+XLA's int8 dot lowering doesn't reach the doubled MXU rate on this
+generation.  Int8's main win here remains the 4× weight-memory cut
+(and with it HBM bandwidth on weight-bound inference).
 """
 import numpy as np
 import jax.numpy as jnp
