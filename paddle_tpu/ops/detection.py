@@ -248,11 +248,17 @@ def polygon_box_transform(ctx, ins, attrs):
 
 
 def _nms_fixed(boxes, scores, iou_thresh, max_out):
-    """Fixed-size NMS via iterative suppression (lax.fori-friendly)."""
+    """Fixed-size NMS via iterative suppression (lax.fori-friendly).
+    Returns (keep, valid): once candidates are exhausted, argmax over
+    the all -inf scores would re-emit index 0 — `valid` marks the slots
+    that selected a real (still-unsuppressed) box, so callers never
+    duplicate the top box into the padding slots."""
     def body(i, state):
-        sc, keep = state
+        sc, keep, valid = state
         best = jnp.argmax(sc)
+        ok = sc[best] > -jnp.inf
         keep = keep.at[i].set(best)
+        valid = valid.at[i].set(ok)
         bb = boxes[best]
         xi = jnp.maximum(boxes[:, 0], bb[0])
         yi = jnp.maximum(boxes[:, 1], bb[1])
@@ -265,10 +271,12 @@ def _nms_fixed(boxes, scores, iou_thresh, max_out):
         iou = inter / jnp.maximum(area + ab - inter, 1e-10)
         sc = jnp.where(iou > iou_thresh, -jnp.inf, sc)
         sc = sc.at[best].set(-jnp.inf)
-        return sc, keep
+        return sc, keep, valid
     keep0 = jnp.zeros((max_out,), jnp.int32)
-    _, keep = jax.lax.fori_loop(0, max_out, body, (scores, keep0))
-    return keep
+    valid0 = jnp.zeros((max_out,), jnp.bool_)
+    _, keep, valid = jax.lax.fori_loop(0, max_out, body,
+                                       (scores, keep0, valid0))
+    return keep, valid
 
 
 @register('multiclass_nms')
@@ -289,15 +297,21 @@ def multiclass_nms(ctx, ins, attrs):
         for c in range(C):
             s = jnp.where(sc[c] >= score_thresh, sc[c], -jnp.inf)
             k = min(keep_top_k, M)
-            keep = _nms_fixed(box, s, nms_thresh, k)
+            keep, valid = _nms_fixed(box, s, nms_thresh, k)
             kept_s = jnp.take(s, keep)
             kept_b = jnp.take(box, keep, axis=0)
-            lab = jnp.where(jnp.isfinite(kept_s), float(c), -1.0)
+            ok = valid & jnp.isfinite(kept_s)
+            lab = jnp.where(ok, float(c), -1.0)
             outs.append(jnp.concatenate(
-                [lab[:, None], jnp.where(jnp.isfinite(kept_s), kept_s,
-                                         0.0)[:, None], kept_b], axis=1))
+                [lab[:, None], jnp.where(ok, kept_s, 0.0)[:, None],
+                 jnp.where(ok[:, None], kept_b, 0.0)], axis=1))
         allc = jnp.concatenate(outs, axis=0)
-        order = jnp.argsort(-allc[:, 1])
+        if allc.shape[0] < keep_top_k:  # honor the fixed [keep, 6] shape
+            pad = jnp.zeros((keep_top_k - allc.shape[0], 6), allc.dtype)
+            allc = jnp.concatenate([allc, pad.at[:, 0].set(-1.0)], axis=0)
+        # invalid rows sort last regardless of their (zeroed) score
+        order = jnp.argsort(jnp.where(allc[:, 0] >= 0, -allc[:, 1],
+                                      jnp.inf))
         return jnp.take(allc, order[:keep_top_k], axis=0)
 
     out = jax.vmap(per_image)(bboxes, scores)
@@ -756,10 +770,10 @@ def generate_proposals(ctx, ins, attrs):
                    (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
         top_s = jnp.where(keep_sz, top_s, -jnp.inf)
         k2 = min(post_n, k1)
-        keep = _nms_fixed(boxes, top_s, nms_thresh, k2)
+        keep, kvalid = _nms_fixed(boxes, top_s, nms_thresh, k2)
         rois = jnp.take(boxes, keep, axis=0)
         probs = jnp.take(top_s, keep)
-        valid = jnp.isfinite(probs)
+        valid = kvalid & jnp.isfinite(probs)
         rois = jnp.where(valid[:, None], rois, 0.0)
         probs = jnp.where(valid, probs, 0.0)
         if k2 < post_n:

@@ -1,0 +1,193 @@
+"""SSD/YOLO detection-family numerics vs hand/numpy references (model:
+reference unittests test_iou_similarity_op / test_box_coder_op /
+test_prior_box_op / test_bipartite_match_op / test_multiclass_nms_op /
+test_target_assign_op).  The RCNN family has its own file
+(test_rcnn.py); this covers the one-stage stack."""
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import get_op
+
+
+def _impl(op):
+    return get_op(op).impl
+
+
+def _np_iou(a, b):
+    xi = max(a[0], b[0]); yi = max(a[1], b[1])
+    xa = min(a[2], b[2]); ya = min(a[3], b[3])
+    inter = max(xa - xi, 0) * max(ya - yi, 0)
+    area = lambda r: max(r[2] - r[0], 0) * max(r[3] - r[1], 0)
+    return inter / max(area(a) + area(b) - inter, 1e-10)
+
+
+def test_iou_similarity_numeric():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], 'float32')
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [0, 0, 1, 1]], 'float32')
+    out = np.asarray(_impl('iou_similarity')(
+        None, {'X': jnp.asarray(x), 'Y': jnp.asarray(y)}, {})['Out'])
+    ref = np.array([[_np_iou(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.array([[0., 0., 2., 2.], [1., 1., 4., 5.]], 'float32')
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, 'float32')
+    tb = np.array([[0.5, 0.5, 2.5, 3.5], [0., 1., 3., 4.]], 'float32')
+    enc = _impl('box_coder')(
+        None, {'PriorBox': jnp.asarray(prior), 'PriorBoxVar': jnp.asarray(pvar),
+               'TargetBox': jnp.asarray(tb)},
+        {'code_type': 'encode_center_size'})['OutputBox']
+    # hand-check one entry: target 0 vs prior 0
+    pw = ph = 2.0
+    tcx, tcy, tw, th = 1.5, 2.0, 2.0, 3.0
+    np.testing.assert_allclose(
+        np.asarray(enc)[0, 0],
+        [(tcx - 1.0) / pw / 0.1, (tcy - 1.0) / ph / 0.1,
+         np.log(tw / pw) / 0.2, np.log(th / ph) / 0.2], rtol=1e-4)
+    # decode(encode(t)) == t, taking the diagonal (each target with its
+    # own prior's code)
+    deltas = np.stack([np.asarray(enc)[i, i] for i in range(2)])
+    dec = _impl('box_coder')(
+        None, {'PriorBox': jnp.asarray(prior), 'PriorBoxVar': jnp.asarray(pvar),
+               'TargetBox': jnp.asarray(deltas[:, None, :].repeat(2, 1))},
+        {'code_type': 'decode_center_size'})['OutputBox']
+    got = np.stack([np.asarray(dec)[i, i] for i in range(2)])
+    np.testing.assert_allclose(got, tb, rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_centers_and_sizes():
+    feat = jnp.zeros((1, 8, 2, 2))
+    img = jnp.zeros((1, 3, 8, 8))
+    out = _impl('prior_box')(
+        None, {'Input': feat, 'Image': img},
+        {'min_sizes': [2.0], 'aspect_ratios': [1.0],
+         'variances': [0.1, 0.1, 0.2, 0.2]})
+    boxes = np.asarray(out['Boxes'])          # [H, W, P, 4] normalized
+    assert boxes.shape == (2, 2, 1, 4)
+    # cell (0,0): center = (0+.5)*4 = 2 px -> box [1,1,3,3]/8
+    np.testing.assert_allclose(boxes[0, 0, 0],
+                               np.array([1, 1, 3, 3]) / 8.0, rtol=1e-5)
+    # cell (1,1): center 6 px -> [5,5,7,7]/8
+    np.testing.assert_allclose(boxes[1, 1, 0],
+                               np.array([5, 5, 7, 7]) / 8.0, rtol=1e-5)
+    var = np.asarray(out['Variances'])
+    np.testing.assert_allclose(var[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_values():
+    feat = jnp.zeros((1, 8, 2, 2))
+    out = _impl('anchor_generator')(
+        None, {'Input': feat},
+        {'anchor_sizes': [4.0], 'aspect_ratios': [1.0],
+         'stride': [4.0, 4.0]})
+    anch = np.asarray(out['Anchors'])
+    assert anch.shape == (2, 2, 1, 4)
+    # cell (0,0): center (2,2), size 4 -> [0,0,4,4]
+    np.testing.assert_allclose(anch[0, 0, 0], [0, 0, 4, 4], rtol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    # classic greedy argmax: global max first, rows/cols knocked out
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.8, 0.7, 0.2]], 'float32')
+    out = _impl('bipartite_match')(
+        None, {'DistMat': jnp.asarray(dist)}, {})
+    col2row = np.asarray(out['ColToRowMatchIndices'])[0]
+    d = np.asarray(out['ColToRowMatchDist'])[0]
+    # 0.9 at (0,0) first; then row1's best remaining is 0.7 at (1,1)
+    assert col2row.tolist() == [0, 1, -1]
+    np.testing.assert_allclose(d, [0.9, 0.7, 0.0], rtol=1e-6)
+
+
+def test_target_assign_numeric():
+    x = np.arange(12, dtype='float32').reshape(4, 3)  # 4 rows, K=3
+    match = np.array([[2, -1, 0]], 'int32')
+    out = _impl('target_assign')(
+        None, {'X': jnp.asarray(x), 'MatchIndices': jnp.asarray(match)},
+        {'mismatch_value': 7.0})
+    o = np.asarray(out['Out'])[0]
+    w = np.asarray(out['OutWeight'])[0]
+    np.testing.assert_allclose(o[0], x[2])
+    np.testing.assert_allclose(o[1], [7.0] * 3)   # mismatched
+    np.testing.assert_allclose(o[2], x[0])
+    np.testing.assert_allclose(w.ravel(), [1.0, 0.0, 1.0])
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # three boxes: two heavy overlaps (keep the higher score), one far
+    boxes = np.array([[[0, 0, 2, 2], [0, 0, 2.1, 2.1],
+                       [5, 5, 7, 7]]], 'float32')
+    scores = np.array([[[0.9, 0.8, 0.6]]], 'float32')  # [N=1, C=1, M=3]
+    out = np.asarray(_impl('multiclass_nms')(
+        None, {'BBoxes': jnp.asarray(boxes), 'Scores': jnp.asarray(scores)},
+        {'score_threshold': 0.1, 'nms_threshold': 0.5,
+         'keep_top_k': 3})['Out'])[0]
+    kept = out[out[:, 0] >= 0]
+    assert kept.shape[0] == 2                      # overlap suppressed
+    np.testing.assert_allclose(kept[0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(kept[1, 1], 0.6, rtol=1e-5)
+    np.testing.assert_allclose(kept[1, 2:], [5, 5, 7, 7], rtol=1e-5)
+
+
+def test_yolov3_loss_perfect_prediction_near_zero_xywh():
+    """Logits constructed to hit the target exactly: the xy/wh terms
+    vanish; obj/cls stay finite and positive."""
+    H = W = 4
+    class_num = 2
+    anchors = [16, 16]
+    N, na = 1, 1
+    gt_box = np.array([[[0.375, 0.625, 0.125, 0.125]]], 'float32')
+    gt_label = np.array([[1]], 'int64')
+    # responsible cell: gi=1, gj=2 (x*W=1.5, y*H=2.5); tx=ty=0.5
+    x = np.zeros((N, na * (5 + class_num), H, W), 'float32')
+    pred = x.reshape(N, na, 5 + class_num, H, W)
+    pred[0, 0, 0, 2, 1] = 0.0        # sigmoid(0)=0.5 == tx
+    pred[0, 0, 1, 2, 1] = 0.0        # ty
+    # tw = log(gtw / (aw/input)) with input=32*... downsample 8 ->
+    # input_size = 8*4=32; aw = 16/32 = 0.5; tw = log(.125/.5)
+    tw = np.log(0.125 / 0.5)
+    pred[0, 0, 2, 2, 1] = tw
+    pred[0, 0, 3, 2, 1] = tw
+    out = _impl('yolov3_loss')(
+        None, {'X': jnp.asarray(x), 'GTBox': jnp.asarray(gt_box),
+               'GTLabel': jnp.asarray(gt_label)},
+        {'anchors': anchors, 'anchor_mask': [0], 'class_num': class_num,
+         'downsample_ratio': 8})['Loss']
+    val = float(np.asarray(out)[0])
+    assert np.isfinite(val) and val > 0
+    # perturbing xy away from target must increase the loss
+    x2 = x.copy()
+    x2.reshape(N, na, 5 + class_num, H, W)[0, 0, 0, 2, 1] = 3.0
+    out2 = _impl('yolov3_loss')(
+        None, {'X': jnp.asarray(x2), 'GTBox': jnp.asarray(gt_box),
+               'GTLabel': jnp.asarray(gt_label)},
+        {'anchors': anchors, 'anchor_mask': [0], 'class_num': class_num,
+         'downsample_ratio': 8})['Loss']
+    assert float(np.asarray(out2)[0]) > val
+
+
+def test_polygon_box_transform_runs():
+    x = np.random.RandomState(0).randn(1, 8, 2, 2).astype('float32')
+    out = _impl('polygon_box_transform')(None, {'Input': jnp.asarray(x)},
+                                         {})
+    o = list(out.values())[0]
+    assert np.asarray(o).shape == (1, 8, 2, 2)
+
+
+def test_multiclass_nms_fixed_shape_and_clean_padding():
+    """Padding rows must be fully zeroed (label -1) — no leaked box
+    coordinates — and the output must honor [N, keep_top_k, 6] even
+    when fewer candidates exist than keep_top_k."""
+    boxes = np.array([[[0, 0, 2, 2], [5, 5, 7, 7]]], 'float32')
+    scores = np.array([[[0.9, 0.6]]], 'float32')
+    out = np.asarray(_impl('multiclass_nms')(
+        None, {'BBoxes': jnp.asarray(boxes), 'Scores': jnp.asarray(scores)},
+        {'score_threshold': 0.1, 'nms_threshold': 0.5,
+         'keep_top_k': 5})['Out'])[0]
+    assert out.shape == (5, 6)
+    assert (out[:2, 0] == 0).all()
+    invalid = out[out[:, 0] < 0]
+    assert invalid.shape[0] == 3
+    np.testing.assert_allclose(invalid[:, 1:], 0.0)
