@@ -288,6 +288,7 @@ def multiclass_nms(ctx, ins, attrs):
     score_thresh = attrs.get('score_threshold', 0.01)
     nms_thresh = attrs.get('nms_threshold', 0.3)
     keep_top_k = attrs.get('keep_top_k', 100)
+    background = attrs.get('background_label', 0)
     if keep_top_k <= 0:
         keep_top_k = 100
     N, C, M = scores.shape
@@ -295,16 +296,19 @@ def multiclass_nms(ctx, ins, attrs):
     def per_image(box, sc):
         outs = []
         for c in range(C):
+            if c == background:  # reference skips the background class
+                continue
             s = jnp.where(sc[c] >= score_thresh, sc[c], -jnp.inf)
             k = min(keep_top_k, M)
-            keep, valid = _nms_fixed(box, s, nms_thresh, k)
+            keep, ok = _nms_fixed(box, s, nms_thresh, k)
             kept_s = jnp.take(s, keep)
             kept_b = jnp.take(box, keep, axis=0)
-            ok = valid & jnp.isfinite(kept_s)
             lab = jnp.where(ok, float(c), -1.0)
             outs.append(jnp.concatenate(
                 [lab[:, None], jnp.where(ok, kept_s, 0.0)[:, None],
                  jnp.where(ok[:, None], kept_b, 0.0)], axis=1))
+        if not outs:  # only the background class exists
+            return jnp.zeros((keep_top_k, 6)).at[:, 0].set(-1.0)
         allc = jnp.concatenate(outs, axis=0)
         if allc.shape[0] < keep_top_k:  # honor the fixed [keep, 6] shape
             pad = jnp.zeros((keep_top_k - allc.shape[0], 6), allc.dtype)
@@ -770,10 +774,9 @@ def generate_proposals(ctx, ins, attrs):
                    (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
         top_s = jnp.where(keep_sz, top_s, -jnp.inf)
         k2 = min(post_n, k1)
-        keep, kvalid = _nms_fixed(boxes, top_s, nms_thresh, k2)
+        keep, valid = _nms_fixed(boxes, top_s, nms_thresh, k2)
         rois = jnp.take(boxes, keep, axis=0)
         probs = jnp.take(top_s, keep)
-        valid = kvalid & jnp.isfinite(probs)
         rois = jnp.where(valid[:, None], rois, 0.0)
         probs = jnp.where(valid, probs, 0.0)
         if k2 < post_n:

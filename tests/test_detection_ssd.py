@@ -123,7 +123,7 @@ def test_multiclass_nms_suppresses_overlaps():
     out = np.asarray(_impl('multiclass_nms')(
         None, {'BBoxes': jnp.asarray(boxes), 'Scores': jnp.asarray(scores)},
         {'score_threshold': 0.1, 'nms_threshold': 0.5,
-         'keep_top_k': 3})['Out'])[0]
+         'keep_top_k': 3, 'background_label': -1})['Out'])[0]
     kept = out[out[:, 0] >= 0]
     assert kept.shape[0] == 2                      # overlap suppressed
     np.testing.assert_allclose(kept[0, 1], 0.9, rtol=1e-5)
@@ -185,9 +185,25 @@ def test_multiclass_nms_fixed_shape_and_clean_padding():
     out = np.asarray(_impl('multiclass_nms')(
         None, {'BBoxes': jnp.asarray(boxes), 'Scores': jnp.asarray(scores)},
         {'score_threshold': 0.1, 'nms_threshold': 0.5,
-         'keep_top_k': 5})['Out'])[0]
+         'keep_top_k': 5, 'background_label': -1})['Out'])[0]
     assert out.shape == (5, 6)
     assert (out[:2, 0] == 0).all()
     invalid = out[out[:, 0] < 0]
     assert invalid.shape[0] == 3
     np.testing.assert_allclose(invalid[:, 1:], 0.0)
+
+
+def test_multiclass_nms_skips_background_class():
+    """Reference semantics: the background class (default label 0) emits
+    no detections even with near-1.0 scores everywhere."""
+    boxes = np.array([[[0, 0, 2, 2], [5, 5, 7, 7]]], 'float32')
+    scores = np.array([[[0.99, 0.98],     # class 0 = background
+                        [0.30, 0.70]]], 'float32')
+    out = np.asarray(_impl('multiclass_nms')(
+        None, {'BBoxes': jnp.asarray(boxes), 'Scores': jnp.asarray(scores)},
+        {'score_threshold': 0.1, 'nms_threshold': 0.5,
+         'keep_top_k': 4})['Out'])[0]
+    kept = out[out[:, 0] >= 0]
+    assert (kept[:, 0] == 1).all()          # only class 1 rows
+    assert kept.shape[0] == 2
+    np.testing.assert_allclose(sorted(kept[:, 1]), [0.3, 0.7], rtol=1e-5)

@@ -1,0 +1,174 @@
+"""Second per-op numeric batch: recurrent units, sampled losses,
+bilinear/row/patch ops (model: reference unittests test_gru_unit_op /
+test_lstm_unit_op / test_nce / test_hsigmoid / test_kldiv_loss_op /
+test_row_conv_op / test_im2sequence_op / test_gather_nd_op)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import get_op
+
+
+def _impl(op):
+    return get_op(op).impl
+
+
+def _sig(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def test_lstm_unit_numeric():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype('float32')   # [B, 4D], D=2
+    c_prev = rng.randn(3, 2).astype('float32')
+    out = _impl('lstm_unit')(
+        None, {'X': jnp.asarray(x), 'C_prev': jnp.asarray(c_prev)},
+        {'forget_bias': 1.0})
+    i, f, g, o = np.split(x, 4, axis=-1)
+    c_ref = _sig(f + 1.0) * c_prev + _sig(i) * np.tanh(g)
+    h_ref = _sig(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(np.asarray(out['C']), c_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out['H']), h_ref, rtol=1e-5)
+
+
+def test_gru_unit_numeric():
+    rng = np.random.RandomState(1)
+    D = 3
+    x = rng.randn(2, 3 * D).astype('float32')   # pre-projected input
+    h_prev = rng.randn(2, D).astype('float32')
+    w = rng.randn(D, 3 * D).astype('float32')
+    out = _impl('gru_unit')(
+        None, {'Input': jnp.asarray(x), 'HiddenPrev': jnp.asarray(h_prev),
+               'Weight': jnp.asarray(w)}, {})
+    xu, xr, xc = np.split(x, 3, axis=-1)
+    ur = _sig(np.concatenate([xu, xr], -1) + h_prev @ w[:, :2 * D])
+    u, r = np.split(ur, 2, axis=-1)
+    c = np.tanh(xc + (r * h_prev) @ w[:, 2 * D:])
+    h_ref = u * h_prev + (1 - u) * c
+    np.testing.assert_allclose(np.asarray(out['Hidden']), h_ref,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_kldiv_loss_reductions():
+    rng = np.random.RandomState(2)
+    x = rng.randn(4, 5).astype('float32')          # log-probs input
+    t = np.abs(rng.rand(4, 5)).astype('float32')
+    raw = t * (np.log(t + 1e-8) - x)
+    for red, ref in (('mean', raw.mean()), ('sum', raw.sum()),
+                     ('batchmean', raw.sum() / 4)):
+        out = _impl('kldiv_loss')(
+            None, {'X': jnp.asarray(x), 'Target': jnp.asarray(t)},
+            {'reduction': red})['Loss']
+        np.testing.assert_allclose(np.asarray(out), [ref], rtol=1e-4)
+
+
+def test_bilinear_tensor_product_numeric():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3).astype('float32')
+    y = rng.randn(2, 4).astype('float32')
+    w = rng.randn(5, 3, 4).astype('float32')
+    b = rng.randn(1, 5).astype('float32')
+    out = _impl('bilinear_tensor_product')(
+        None, {'X': jnp.asarray(x), 'Y': jnp.asarray(y),
+               'Weight': jnp.asarray(w), 'Bias': jnp.asarray(b)}, {})['Out']
+    ref = np.einsum('bi,oij,bj->bo', x, w, y) + b
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_row_conv_lookahead():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 5, 2).astype('float32')
+    w = rng.randn(3, 2).astype('float32')     # future context 3
+    out = _impl('row_conv')(
+        None, {'X': jnp.asarray(x), 'Filter': jnp.asarray(w)}, {})['Out']
+    ref = np.zeros_like(x)
+    for t in range(5):
+        for k in range(3):
+            if t + k < 5:
+                ref[0, t] += x[0, t + k] * w[k]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_im2sequence_patches():
+    x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+    out = _impl('im2sequence')(
+        None, {'X': jnp.asarray(x)},
+        {'kernels': [2, 2], 'strides': [2, 2]})['Out']
+    o = np.asarray(out)
+    assert o.shape == (1, 4, 4)               # 2x2 grid of 2x2 patches
+    np.testing.assert_allclose(o[0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(o[0, 3], [10, 11, 14, 15])
+
+
+def test_gather_nd_numeric():
+    x = np.arange(24, dtype='float32').reshape(2, 3, 4)
+    idx = np.array([[0, 2], [1, 0]], 'int32')   # rows of [d0, d1]
+    out = _impl('gather_nd')(
+        None, {'X': jnp.asarray(x), 'Index': jnp.asarray(idx)}, {})['Out']
+    np.testing.assert_allclose(np.asarray(out), x[[0, 1], [2, 0]])
+
+
+def test_nce_sampled_softmax_loss():
+    """NCE: replicate the op's uniform sampling (same key) and verify
+    the binary-CE arithmetic over [true, negatives] logits."""
+
+    class Ctx:
+        def rng(self):
+            return jax.random.key(0)
+
+    rng = np.random.RandomState(5)
+    w = rng.randn(16, 4).astype('float32')
+    x = rng.randn(2, 4).astype('float32')
+    lab = np.array([[3], [7]], 'int64')
+    K = 5
+    out = _impl('nce')(
+        Ctx(), {'Input': jnp.asarray(x), 'Weight': jnp.asarray(w),
+                'Label': jnp.asarray(lab)},
+        {'num_neg_samples': K, 'num_total_classes': 16})
+    cost_key = 'Cost' if 'Cost' in out else sorted(out.keys())[0]
+    got = np.asarray(out[cost_key]).reshape(2, -1).sum(-1)
+    neg = np.asarray(jax.random.randint(jax.random.key(0), (2, K), 0, 16))
+    ids = np.concatenate([lab.astype(np.int64), neg], axis=1)
+    logits = np.einsum('bd,bkd->bk', x, w[ids])
+    y = np.concatenate([np.ones((2, 1)), np.zeros((2, K))], axis=1)
+    bce = np.maximum(logits, 0) - logits * y + np.log1p(
+        np.exp(-np.abs(logits)))
+    np.testing.assert_allclose(got, bce.sum(-1), rtol=1e-4)
+
+
+def test_hierarchical_sigmoid_paths():
+    """hsigmoid loss must be finite and positive, with finite gradients
+    through the binary-tree path selection."""
+    rng = np.random.RandomState(6)
+    num_classes = 8
+    w = rng.randn(num_classes, 4).astype('float32')
+    lab = np.array([[2], [5]], 'int64')
+    x = rng.randn(2, 4).astype('float32')
+
+    def cost_arr(xv):
+        out = _impl('hierarchical_sigmoid')(
+            None, {'X': xv, 'W': jnp.asarray(w),
+                   'Label': jnp.asarray(lab)},
+            {'num_classes': num_classes})
+        return out['Cost'] if 'Cost' in out else list(out.values())[0]
+
+    c = np.asarray(cost_arr(jnp.asarray(x)))
+    assert np.isfinite(c).all() and (c > 0).all()
+    g = jax.grad(lambda xv: jnp.sum(cost_arr(xv)))(jnp.asarray(x))
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_data_norm_numeric():
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 3).astype('float32')
+    sizes = np.full((3,), 10.0, 'float32')
+    sums = rng.randn(3).astype('float32') * 10
+    sq = (np.abs(rng.randn(3)) * 30 + 50).astype('float32')
+    out = _impl('data_norm')(
+        None, {'X': jnp.asarray(x), 'BatchSize': jnp.asarray(sizes),
+               'BatchSum': jnp.asarray(sums),
+               'BatchSquareSum': jnp.asarray(sq)}, {})
+    means = sums / 10.0
+    scales = 1 / np.sqrt(sq / 10.0 - means ** 2 + 1e-4)
+    np.testing.assert_allclose(np.asarray(out['Y']), (x - means) * scales,
+                               rtol=1e-4)
