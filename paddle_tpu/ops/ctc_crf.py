@@ -104,9 +104,18 @@ def ctc_align(ctx, ins, attrs):
     x = ins['X']
     blank = int(attrs.get('blank', 0))
     merge = bool(attrs.get('merge_repeated', True))
-    if x.ndim == 3:  # raw probs/logits: take the greedy path first
+    if x.ndim == 3 and x.shape[-1] > 1:
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            raise ValueError(
+                'ctc_align input must be float [B, T, V] logits/probs or '
+                'integer ids [B, T] / [B, T, 1]; got %s %s' %
+                (x.dtype, x.shape))
+        # raw probs/logits [B, T, V]: take the greedy path first
         tok = jnp.argmax(x, axis=-1).astype(jnp.int32)
     else:
+        # already token ids — [B, T] or the fluid [B, T, 1] id layout
+        # (which must NOT be argmaxed: over a size-1 axis that decodes
+        # every frame to 0)
         tok = _squeeze_label(x).astype(jnp.int32)
     B, T = tok.shape
     length = _length_or_full(ins, x).astype(jnp.int32)

@@ -172,3 +172,68 @@ def test_data_norm_numeric():
     scales = 1 / np.sqrt(sq / 10.0 - means ** 2 + 1e-4)
     np.testing.assert_allclose(np.asarray(out['Y']), (x - means) * scales,
                                rtol=1e-4)
+
+
+def test_auc_streaming_numeric():
+    """AUC histogram accumulation: perfect separation -> 1.0; reversed
+    scores -> 0.0."""
+    nt = 127
+    zeros = jnp.zeros((nt + 1,), jnp.float32)
+
+    def auc_of(preds, labels):
+        out = _impl('auc')(
+            None, {'Predict': jnp.asarray(preds),
+                   'Label': jnp.asarray(labels),
+                   'StatPos': zeros, 'StatNeg': zeros},
+            {'num_thresholds': nt})
+        return float(np.asarray(out['AUC']).ravel()[0])
+
+    p = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.9, 0.1]],
+                 'float32')
+    lab = np.array([[1], [0], [1], [0]], 'int64')
+    assert abs(auc_of(p, lab) - 1.0) < 1e-3
+    assert abs(auc_of(p, 1 - lab)) < 1e-3
+
+
+def test_ctc_align_greedy_decode():
+    """argmax path -> merge repeats -> drop blanks (blank=0)."""
+    # tokens over time: [1, 1, 0, 2, 2, 3] -> [1, 2, 3]
+    tok = np.array([[1, 1, 0, 2, 2, 3]], 'int64')
+    out = _impl('ctc_align')(
+        None, {'X': jnp.asarray(tok[..., None])},
+        {'blank': 0, 'merge_repeated': True})
+    o = np.asarray(out['Output']).reshape(1, -1)
+    ln = np.asarray(out['OutLength']).ravel()
+    assert ln[0] == 3
+    np.testing.assert_array_equal(o[0, :3], [1, 2, 3])
+
+
+def test_strided_slice_numeric():
+    x = np.arange(24, dtype='float32').reshape(2, 3, 4)
+    out = _impl('strided_slice')(
+        None, {'Input': jnp.asarray(x)},
+        {'axes': [1, 2], 'starts': [0, 1], 'ends': [3, 4],
+         'strides': [2, 2]})['Out']
+    np.testing.assert_allclose(np.asarray(out), x[:, 0:3:2, 1:4:2])
+
+
+def test_assign_value_numeric():
+    out = _impl('assign_value')(
+        None, {}, {'shape': [2, 2], 'values': [1.0, 2.0, 3.0, 4.0],
+                   'dtype': 'float32'})['Out']
+    np.testing.assert_allclose(np.asarray(out), [[1, 2], [3, 4]])
+
+
+def test_random_crop_shape_and_content():
+    class Ctx:
+        def rng(self):
+            return jax.random.key(3)
+
+    x = np.arange(64, dtype='float32').reshape(1, 8, 8)
+    out = np.asarray(_impl('random_crop')(
+        Ctx(), {'X': jnp.asarray(x)}, {'shape': [4, 4]})['Out'])
+    assert out.shape == (1, 4, 4)
+    # the crop is a contiguous window: rows step by 8, cols by 1
+    r0 = out[0, 0, 0]
+    expect = r0 + np.arange(4)[:, None] * 8 + np.arange(4)[None, :]
+    np.testing.assert_allclose(out[0], expect)
