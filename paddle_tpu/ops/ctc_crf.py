@@ -116,6 +116,11 @@ def ctc_align(ctx, ins, attrs):
         # already token ids — [B, T] or the fluid [B, T, 1] id layout
         # (which must NOT be argmaxed: over a size-1 axis that decodes
         # every frame to 0)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            raise ValueError(
+                'ctc_align id-shaped input (%s) must be integer tokens; '
+                'float probabilities need the [B, T, V>1] logits layout'
+                % (x.shape,))
         tok = _squeeze_label(x).astype(jnp.int32)
     B, T = tok.shape
     length = _length_or_full(ins, x).astype(jnp.int32)
