@@ -268,3 +268,35 @@ def test_inference_transpiler_fold_edge_cases():
         after, = exe.run(infer2, feed={'x': xt}, fetch_list=[loss])
     np.testing.assert_allclose(np.asarray(before), np.asarray(after),
                                rtol=1e-6)
+
+
+def test_contrib_memory_usage_and_op_freq():
+    """contrib.memory_usage_calc + op_frequence over a real program
+    (parity: reference contrib utilities)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.contrib.memory_usage_calc import memory_usage
+    from paddle_tpu.contrib.op_frequence import op_freq_statistic
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data('x', shape=[16], dtype='float32')
+            h = layers.fc(x, 32, act='relu')
+            h = layers.fc(h, 8)
+            loss = layers.reduce_mean(h)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    gb, unit = memory_usage(main, batch_size=64)
+    assert unit == 'GB' and gb > 0
+    # doubling batch grows the (activation-dominated) estimate
+    gb2, _ = memory_usage(main, batch_size=128)
+    assert gb2 > gb
+    with np.testing.assert_raises(ValueError):
+        memory_usage(main, batch_size=0)
+    uni, adj = op_freq_statistic(main)
+    assert uni['mul'] == 2
+    assert uni['relu'] == 1
+    assert any(k.startswith('mul->') for k in adj)
+    # sorted by descending frequency
+    counts = list(uni.values())
+    assert counts == sorted(counts, reverse=True)
