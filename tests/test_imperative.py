@@ -274,3 +274,56 @@ def test_state_dict_roundtrip():
         fc.set_dict(state)
         out3 = np.asarray(fc(x).numpy())
         assert np.allclose(out1, out3)
+
+
+def test_dygraph_matches_static_numerics():
+    """The SAME model with the SAME weights and data must produce the
+    same loss and the same post-step weights in imperative (dygraph)
+    and declarative (program) mode — the consistency contract between
+    the two execution paths."""
+    rng = np.random.RandomState(7)
+    w0 = rng.randn(4, 2).astype('float32') * 0.3
+    xb = rng.rand(8, 4).astype('float32')
+    yb = rng.rand(8, 2).astype('float32')
+    lr = 0.1
+
+    # --- static program mode
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[4], dtype='float32')
+            y = fluid.layers.data('y', shape=[2], dtype='float32')
+            p = fluid.layers.fc(x, 2, bias_attr=False,
+                                param_attr=fluid.ParamAttr(
+                                    name='cmp_w',
+                                    initializer=fluid.initializer.
+                                    NumpyArrayInitializer(w0)))
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(fluid.layers.elementwise_sub(p, y)))
+            fluid.optimizer.SGD(lr).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ls, = exe.run(main, feed={'x': xb, 'y': yb}, fetch_list=[loss])
+        w_static = np.asarray(scope.get('cmp_w')).copy()
+    loss_static = float(np.asarray(ls).ravel()[0])
+
+    # --- dygraph mode, same weights
+    with imperative.guard():
+        fc = imperative.FC(2, bias_attr=False,
+                           param_attr=fluid.ParamAttr(
+                               initializer=fluid.initializer.
+                               NumpyArrayInitializer(w0)))
+        sgd = fluid.optimizer.SGDOptimizer(learning_rate=lr)
+        xv = imperative.to_variable(xb)
+        yv = imperative.to_variable(yb)
+        out = fc(xv)
+        l = fluid.layers.reduce_mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(out, yv)))
+        sgd.minimize(l)
+        loss_dy = float(np.asarray(l.numpy()).reshape(()))
+        w_dy = np.asarray(list(fc.parameters())[0].numpy())
+
+    np.testing.assert_allclose(loss_dy, loss_static, rtol=1e-5)
+    np.testing.assert_allclose(w_dy, w_static, rtol=1e-5, atol=1e-6)

@@ -273,18 +273,16 @@ def test_inference_transpiler_fold_edge_cases():
 def test_contrib_memory_usage_and_op_freq():
     """contrib.memory_usage_calc + op_frequence over a real program
     (parity: reference contrib utilities)."""
-    import paddle_tpu as fluid
-    from paddle_tpu import layers
     from paddle_tpu.contrib.memory_usage_calc import memory_usage
     from paddle_tpu.contrib.op_frequence import op_freq_statistic
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         with fluid.unique_name.guard():
-            x = layers.data('x', shape=[16], dtype='float32')
-            h = layers.fc(x, 32, act='relu')
-            h = layers.fc(h, 8)
-            loss = layers.reduce_mean(h)
+            x = fluid.layers.data('x', shape=[16], dtype='float32')
+            h = fluid.layers.fc(x, 32, act='relu')
+            h = fluid.layers.fc(h, 8)
+            loss = fluid.layers.reduce_mean(h)
             fluid.optimizer.SGD(0.1).minimize(loss)
     gb, unit = memory_usage(main, batch_size=64)
     assert unit == 'GB' and gb > 0
