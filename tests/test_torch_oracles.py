@@ -1,0 +1,76 @@
+"""Cross-checks of the hardest ops against torch (CPU) as an
+independent oracle: CTC loss (forward AND gradient), grid_sampler,
+affine_grid — conventions like align_corners and blank handling are
+where hand-rolled references can silently agree with their own bugs."""
+import numpy as np
+import torch
+import torch.nn.functional as F
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import get_op
+
+
+def _impl(op):
+    return get_op(op).impl
+
+
+def test_warpctc_loss_and_grad_vs_torch():
+    rng = np.random.RandomState(0)
+    B, T, C, L = 3, 8, 5, 3
+    logits = rng.randn(B, T, C).astype('float32')
+    labels = rng.randint(1, C, (B, L)).astype('int64')   # 0 is blank
+    t_lens = np.array([8, 7, 6], 'int32')
+    l_lens = np.array([3, 2, 3], 'int32')
+
+    out = _impl('warpctc')(
+        None, {'Logits': jnp.asarray(logits), 'Label': jnp.asarray(labels),
+               'LogitsLength': jnp.asarray(t_lens),
+               'LabelLength': jnp.asarray(l_lens)}, {'blank': 0})['Loss']
+    got = np.asarray(out).ravel()
+
+    tl = torch.from_numpy(logits).requires_grad_(True)
+    lp = F.log_softmax(tl, dim=-1).transpose(0, 1)      # [T, B, C]
+    ref = F.ctc_loss(lp, torch.from_numpy(labels),
+                     torch.from_numpy(t_lens.astype('int64')),
+                     torch.from_numpy(l_lens.astype('int64')),
+                     blank=0, reduction='none', zero_infinity=False)
+    np.testing.assert_allclose(got, ref.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+    # gradients wrt logits
+    g = jax.grad(lambda lg: jnp.sum(_impl('warpctc')(
+        None, {'Logits': lg, 'Label': jnp.asarray(labels),
+               'LogitsLength': jnp.asarray(t_lens),
+               'LabelLength': jnp.asarray(l_lens)},
+        {'blank': 0})['Loss']))(jnp.asarray(logits))
+    ref.sum().backward()
+    np.testing.assert_allclose(np.asarray(g), tl.grad.numpy(), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_grid_sampler_vs_torch():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 6, 6).astype('float32')
+    grid = rng.uniform(-1, 1, (2, 4, 4, 2)).astype('float32')
+    out = _impl('grid_sampler')(
+        None, {'X': jnp.asarray(x), 'Grid': jnp.asarray(grid)}, {})['Output']
+    # reference grid_sampler: bilinear, align_corners=True semantics
+    ref = F.grid_sample(torch.from_numpy(x), torch.from_numpy(grid),
+                        mode='bilinear', padding_mode='zeros',
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_affine_grid_vs_torch():
+    theta = np.array([[[1.0, 0.2, 0.1],
+                       [-0.1, 0.9, -0.3]],
+                      [[0.8, 0.0, 0.0],
+                       [0.0, 1.1, 0.2]]], 'float32')
+    out = _impl('affine_grid')(
+        None, {'Theta': jnp.asarray(theta)},
+        {'output_shape': [2, 3, 4, 5]})
+    got = np.asarray(list(out.values())[0])
+    ref = F.affine_grid(torch.from_numpy(theta), (2, 3, 4, 5),
+                        align_corners=True).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
