@@ -87,8 +87,6 @@ def prior_box(ctx, ins, attrs):
     for ms in min_sizes:
         for a in ars:
             boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
-        if max_sizes:
-            pass
     for ms, mxs in zip(min_sizes, max_sizes or []):
         boxes.append((np.sqrt(ms * mxs), np.sqrt(ms * mxs)))
     nprior = len(boxes)
