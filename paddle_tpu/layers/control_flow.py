@@ -272,13 +272,20 @@ class ConditionalBlock(object):
 class Switch(object):
     """Mutually-exclusive cases (ref Switch).  Branch-free lowering: each
     case body runs and results blend via masks — all cases must write the
-    same output vars via layers.assign."""
+    same output vars via layers.assign.  Like the reference's if/elif
+    chain, the FIRST matching case wins when conditions overlap.
+    Usable bare or as a context manager (`with Switch() as switch:`,
+    the reference's documented form)."""
 
     def __init__(self, name=None):
         self.helper = LayerHelper('switch', name=name)
-        self._cases = []
-        self._assigns = []  # (cond or None, [(target, value)])
-        self._current = None
+        self._cases = []   # conds registered at case ENTRY, in order
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
 
     def case(self, condition):
         return _SwitchCase(self, condition)
@@ -288,12 +295,36 @@ class Switch(object):
 
 
 class _SwitchCase(object):
+    """One case scope.  The effective mask (cond AND no-earlier-case) is
+    computed ONCE at entry — every assign inside the body blends with
+    the same mask, and a case with zero assigns still claims its rows
+    from default()."""
+
     def __init__(self, switch, condition):
         self.switch = switch
         self.condition = condition
+        self.eff = None   # None => unconditional (default with no cases)
 
     def __enter__(self):
-        _switch_stack.append((self.switch, self.condition))
+        if _switch_stack:
+            raise NotImplementedError(
+                'nested Switch is not supported: flatten the conditions '
+                '(logical_and of the outer and inner case predicates)')
+        sw = self.switch
+        taken = None
+        for prev in sw._cases:
+            taken = prev if taken is None else \
+                nn_layers.logical_or(taken, prev)
+        if self.condition is None:      # default: rows no case claimed
+            self.eff = (None if taken is None
+                        else nn_layers.logical_not(taken))
+        else:
+            self.eff = (self.condition if taken is None
+                        else nn_layers.logical_and(
+                            self.condition,
+                            nn_layers.logical_not(taken)))
+            sw._cases.append(self.condition)
+        _switch_stack.append(self)
         return self
 
     def __exit__(self, *a):
@@ -304,38 +335,26 @@ class _SwitchCase(object):
 _switch_stack = []
 
 
+def _raw_assign(value, output):
+    """Append a plain assign op, bypassing the switch-aware public
+    layers.assign (which would re-enter the blend)."""
+    helper = LayerHelper('assign')
+    helper.append_op(type='assign', inputs={'X': value},
+                     outputs={'Out': output}, attrs={})
+    return output
+
+
 def _in_switch_assign(output, value):
-    """Blend `value` into `output` under the innermost active switch case."""
-    sw, cond = _switch_stack[-1]
-    if cond is None:
-        # default: apply where no previous case hit
-        taken = None
-        for prev_cond in sw._cases:
-            taken = prev_cond if taken is None else \
-                nn_layers.logical_or(taken, prev_cond)
-        if taken is None:
-            tensor_layers.assign(value, output)
-            return
-        mask = tensor_layers.cast(nn_layers.logical_not(taken), 'float32')
-    else:
-        sw._cases.append(cond)
-        mask = tensor_layers.cast(cond, 'float32')
+    """Blend `value` into `output` under the active switch case's mask
+    (first matching case wins — the reference's if/elif semantics).
+    Invoked by layers.assign whenever a Switch case is active."""
+    case = _switch_stack[-1]
+    if case.eff is None:   # default with no preceding cases
+        _raw_assign(value, output)
+        return
+    mask = tensor_layers.cast(case.eff, 'float32')
     blended = mask * value + (1.0 - mask) * output
-    tensor_layers.assign(blended, output)
-
-
-# patch tensor.assign to respect active switch scope
-_orig_assign = tensor_layers.assign
-
-
-def _switch_aware_assign(input, output=None):
-    if _switch_stack and output is not None:
-        _in_switch_assign(output, input)
-        return output
-    return _orig_assign(input, output)
-
-
-tensor_layers.assign = _switch_aware_assign
+    _raw_assign(blended, output)
 
 
 class IfElse(object):

@@ -79,6 +79,16 @@ def sums(input, out=None):
 
 
 def assign(input, output=None):
+    # inside an active Switch case, an assign to an existing var blends
+    # under the case mask (first matching case wins) instead of
+    # overwriting — the Switch lowering contract (see layers/control_flow)
+    if output is not None:
+        from . import control_flow as _cf
+        if _cf._switch_stack:
+            if not isinstance(input, Variable):
+                input = assign(input)   # materialize const as a temp var
+            _cf._in_switch_assign(output, input)
+            return output
     helper = LayerHelper('assign')
     if isinstance(input, Variable):
         if output is None:

@@ -5,6 +5,7 @@ test_ifelse.py and the book MT decoder pattern
 (tests/book/test_machine_translation.py / test_rnn_encoder_decoder.py).
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -305,3 +306,130 @@ def test_ifelse_single_branch_zeroes_unselected_rows():
     mask = xv[:, :1] < 0.5
     np.testing.assert_allclose(np.asarray(got),
                                np.where(mask, xv * 3.0, 0.0), rtol=1e-6)
+
+
+@pytest.mark.parametrize('which', ['all_true', 'all_false'])
+def test_ifelse_degenerate_masks(which):
+    """Every row takes ONE branch: the select-masking merge must not be
+    poisoned by the other (empty) branch — including through gradients
+    (NaN/Inf from a degenerate branch would leak via 0*inf)."""
+    B, D = 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x0 = fluid.layers.data('x', shape=[B, D], dtype='float32',
+                                   append_batch_size=False)
+            x = layers.fc(x0, D, bias_attr=False,
+                          param_attr=fluid.ParamAttr(
+                              name='deg_w', initializer=fluid.initializer.
+                              NumpyArrayInitializer(np.eye(D, dtype='float32'))))
+            limit = layers.fill_constant([B, 1], 'float32',
+                                         2.0 if which == 'all_true'
+                                         else -2.0)
+            first = layers.slice(x, axes=[1], starts=[0], ends=[1])
+            cond = layers.less_than(first, limit)   # rows in [0,1)
+            ie = layers.IfElse(cond)
+            with ie.true_block():
+                xt = ie.input(x)
+                ie.output(layers.scale(xt, scale=2.0))
+            with ie.false_block():
+                xf = ie.input(x)
+                # sqrt: NaN gradients for the masked-out branch would
+                # poison the merge (and the fc weight grad) if wrong
+                ie.output(layers.sqrt(xf))
+            merged, = ie()
+            loss = layers.reduce_mean(merged)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    xv = np.random.RandomState(0).rand(B, D).astype('float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        got, lv = exe.run(main, feed={'x': xv},
+                          fetch_list=[merged, loss])
+        w1 = np.asarray(scope.get('deg_w'))
+    want = xv * 2.0 if which == 'all_true' else np.sqrt(xv)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+    assert np.isfinite(float(np.asarray(lv).ravel()[0]))
+    assert np.isfinite(w1).all()  # no NaN grads leaked into the update
+
+
+def test_switch_default_and_order():
+    """Switch: first matching case wins; default fires when none match."""
+    def run(lr_val):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                step = layers.fill_constant([1], 'float32', lr_val)
+                out = fluid.layers.create_global_var(
+                    [1], 0.0, 'float32', persistable=True, name='sw_out')
+                with fluid.layers.Switch() as switch:
+                    with switch.case(layers.less_than(
+                            step, layers.fill_constant([1], 'float32',
+                                                       1.0))):
+                        layers.assign(layers.fill_constant(
+                            [1], 'float32', 111.0), out)
+                    with switch.case(layers.less_than(
+                            step, layers.fill_constant([1], 'float32',
+                                                       2.0))):
+                        layers.assign(layers.fill_constant(
+                            [1], 'float32', 222.0), out)
+                    with switch.default():
+                        layers.assign(layers.fill_constant(
+                            [1], 'float32', 333.0), out)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            v, = exe.run(main, fetch_list=['sw_out'])
+        return float(np.asarray(v).ravel()[0])
+
+    assert run(0.5) == 111.0    # first case (also matches second)
+    assert run(1.5) == 222.0
+    assert run(5.0) == 333.0    # default
+
+
+def test_switch_multi_assign_and_const_values():
+    """Every assign in one case body blends with the SAME case mask
+    (a per-assign registration would mask the second assign to a no-op),
+    and non-Variable values (python lists) materialize correctly."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            step = layers.fill_constant([1], 'float32', 0.5)
+            a = fluid.layers.create_global_var([1], 0.0, 'float32',
+                                               persistable=True, name='ma')
+            b = fluid.layers.create_global_var([2], 0.0, 'float32',
+                                               persistable=True, name='mb')
+            one = layers.fill_constant([1], 'float32', 1.0)
+            with fluid.layers.Switch() as switch:
+                with switch.case(layers.less_than(step, one)):
+                    layers.assign(layers.fill_constant([1], 'float32',
+                                                       11.0), a)
+                    layers.assign(np.array([22.0, 33.0], 'float32'), b)
+                with switch.default():
+                    layers.assign(layers.fill_constant([1], 'float32',
+                                                       -1.0), a)
+                    layers.assign(np.array([-2.0, -3.0], 'float32'), b)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        av, bv = exe.run(main, fetch_list=['ma', 'mb'])
+    np.testing.assert_allclose(np.asarray(av), [11.0])
+    np.testing.assert_allclose(np.asarray(bv), [22.0, 33.0])
+
+
+def test_switch_nested_raises():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        cond = layers.less_than(layers.fill_constant([1], 'float32', 0.0),
+                                layers.fill_constant([1], 'float32', 1.0))
+        out = fluid.layers.create_global_var([1], 0.0, 'float32',
+                                             persistable=True, name='nso')
+        with fluid.layers.Switch() as outer:
+            with outer.case(cond):
+                inner = fluid.layers.Switch()
+                with pytest.raises(NotImplementedError):
+                    with inner.case(cond):
+                        layers.assign(layers.fill_constant(
+                            [1], 'float32', 1.0), out)
