@@ -127,3 +127,69 @@ def test_lr_scheduler_decays():
         prev = cur
     assert deltas[1] == pytest.approx(deltas[0] * 0.5, rel=1e-3)
     assert deltas[2] == pytest.approx(deltas[1] * 0.5, rel=1e-3)
+
+
+def test_all_lr_schedules_numeric():
+    """Every LR schedule's VALUE sequence vs the reference closed form
+    (model: reference test_learning_rate_scheduler.py)."""
+    import math
+
+    def run_schedule(build, steps=5):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                lr = build()
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        vals = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(steps):
+                v, = exe.run(main, fetch_list=[lr])
+                vals.append(float(np.asarray(v).ravel()[0]))
+        return vals
+
+    base, dsteps, rate = 0.5, 2, 0.7
+    # exponential: base * rate^(step/dsteps); staircase floors the ratio
+    got = run_schedule(lambda: fluid.layers.exponential_decay(
+        base, dsteps, rate, staircase=False))
+    want = [base * rate ** (s / dsteps) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = run_schedule(lambda: fluid.layers.exponential_decay(
+        base, dsteps, rate, staircase=True))
+    want = [base * rate ** (s // dsteps) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = run_schedule(lambda: fluid.layers.natural_exp_decay(
+        base, dsteps, rate, staircase=False))
+    want = [base * math.exp(-rate * s / dsteps) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = run_schedule(lambda: fluid.layers.inverse_time_decay(
+        base, dsteps, rate, staircase=False))
+    want = [base / (1 + rate * s / dsteps) for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    # polynomial: (base - end) * (1 - step/decay_steps)^power + end
+    got = run_schedule(lambda: fluid.layers.polynomial_decay(
+        base, decay_steps=4, end_learning_rate=0.1, power=2.0))
+    want = [(base - 0.1) * (1 - min(s, 4) / 4) ** 2 + 0.1
+            for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = run_schedule(lambda: fluid.layers.piecewise_decay(
+        boundaries=[2, 4], values=[1.0, 0.5, 0.1]), steps=6)
+    want = [1.0, 1.0, 0.5, 0.5, 0.1, 0.1]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    # noam: d^-0.5 * min(step^-0.5, step * warmup^-1.5); step counts from 1
+    got = run_schedule(lambda: fluid.layers.noam_decay(64, 3))
+    want = [64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 3 ** -1.5)
+            for s in range(5)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = run_schedule(lambda: fluid.layers.cosine_decay(
+        base, step_each_epoch=2, epochs=4), steps=6)
+    want = [base / 2 * (math.cos((s // 2) * math.pi / 4) + 1)
+            for s in range(6)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
