@@ -26,6 +26,15 @@ def _resolve(main_program):
         default_main_program()
 
 
+def _store_path(dirname, filename):
+    """np.savez APPENDS '.npz' to paths missing it — normalize here so a
+    save/load pair with the same user filename always meets on disk."""
+    name = os.fspath(filename) if filename else _PARAMS_FILE
+    if not name.endswith('.npz'):
+        name += '.npz'
+    return os.path.join(dirname, name)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     main_program = _resolve(main_program)
@@ -39,7 +48,7 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         name = v.name if isinstance(v, Variable) else v
         if name in scope:
             arrays[name] = np.asarray(scope.get(name))
-    np.savez(os.path.join(dirname, filename or _PARAMS_FILE), **arrays)
+    np.savez(_store_path(dirname, filename), **arrays)
 
 
 def _is_param(v):
@@ -65,8 +74,7 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
-    path = os.path.join(dirname, filename or _PARAMS_FILE)
-    data = np.load(path, allow_pickle=False)
+    data = np.load(_store_path(dirname, filename), allow_pickle=False)
     scope = global_scope()
     names = {v.name if isinstance(v, Variable) else v for v in vars}
     for name in data.files:

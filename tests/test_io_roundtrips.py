@@ -1,0 +1,99 @@
+"""io.py save/load edge cases (model: reference test_io_save_load
+unittests): predicate-filtered save_vars, params vs persistables
+scope, cross-program load, single-file mode, checkpoint step."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build(scale):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data('x', shape=[3], dtype='float32')
+            h = layers.fc(x, 4, param_attr=fluid.ParamAttr(
+                name='io_w', initializer=fluid.initializer.Constant(scale)),
+                bias_attr=fluid.ParamAttr(
+                    name='io_b',
+                    initializer=fluid.initializer.Constant(scale / 2)))
+            loss = layers.reduce_mean(h)
+            fluid.optimizer.Adam(1e-3).minimize(loss)  # adds accumulators
+    return main, startup, loss
+
+
+def test_save_params_vs_persistables_scope(tmp_path):
+    main, startup, loss = _build(1.0)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={'x': np.ones((2, 3), 'float32')},
+                fetch_list=[loss])
+        pdir, adir = str(tmp_path / 'p'), str(tmp_path / 'a')
+        fluid.io.save_params(exe, pdir, main)
+        fluid.io.save_persistables(exe, adir, main)
+    import os
+    pkeys = set(np.load(os.path.join(pdir, '__params__.npz')).files)
+    akeys = set(np.load(os.path.join(adir, '__params__.npz')).files)
+    assert {'io_w', 'io_b'} <= pkeys
+    # params-only save excludes optimizer accumulators; persistables has
+    # them (adam moments + beta powers + step counters)
+    assert not any('moment' in k for k in pkeys)
+    assert any('moment' in k for k in akeys)
+    assert pkeys < akeys
+
+
+def test_save_vars_predicate_and_cross_program_load(tmp_path):
+    main, startup, loss = _build(3.0)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    d = str(tmp_path / 'w_only')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_vars(exe, d, main,
+                           predicate=lambda v: v.name == 'io_w')
+    import os
+    keys = np.load(os.path.join(d, '__params__.npz')).files
+    assert list(keys) == ['io_w']
+    # load into a FRESH scope for the same-structure program built anew
+    main2, startup2, _ = _build(0.0)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup2)
+        fluid.io.load_vars(exe, d, main2,
+                           predicate=lambda v: v.name == 'io_w')
+        w = np.asarray(scope2.get('io_w'))
+        b = np.asarray(scope2.get('io_b'))
+    np.testing.assert_allclose(w, 3.0)   # loaded
+    np.testing.assert_allclose(b, 0.0)   # untouched by predicate
+
+
+def test_single_file_save_load(tmp_path):
+    main, startup, _ = _build(2.0)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_params(exe, str(tmp_path), main,
+                             filename='all_in_one')
+    # np.savez appends .npz; load must meet save at the same path
+    assert (tmp_path / 'all_in_one.npz').exists()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup)
+        fluid.io.load_params(exe, str(tmp_path), main,
+                             filename='all_in_one')
+        np.testing.assert_allclose(np.asarray(scope2.get('io_w')), 2.0)
+
+
+def test_checkpoint_records_step(tmp_path):
+    main, startup, loss = _build(1.0)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_checkpoint(exe, str(tmp_path), main, step=42)
+        step = fluid.io.load_checkpoint(exe, str(tmp_path), main)
+    assert step == 42
