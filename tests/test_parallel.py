@@ -182,3 +182,48 @@ def test_pipeline_differentiable():
         [{'w': jnp.array(p['w'])} for p in params]))
     np.testing.assert_allclose(np.asarray(gp['w']), np.asarray(gs['w']),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_compiled_program_data_parallel_matches_plain():
+    """CompiledProgram().with_data_parallel() through Executor.run must
+    train identically to the plain program (the reference's compiled
+    path wraps ParallelExecutor; here it partitions the one executable
+    over the mesh)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data('x', shape=[4], dtype='float32')
+                y = fluid.layers.data('y', shape=[1], dtype='float32')
+                p = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(
+                    name='cp_w',
+                    initializer=fluid.initializer.Constant(0.5)))
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square_error_cost(p, y))
+                fluid.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    feed = {'x': rng.rand(8, 4).astype('float32'),
+            'y': rng.rand(8, 1).astype('float32')}
+
+    main, startup, loss = build()
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        plain, = exe.run(main, feed=feed, fetch_list=[loss])
+        w_plain = np.asarray(scope.get('cp_w')).copy()
+
+    main2, startup2, loss2 = build()
+    compiled = fluid.CompiledProgram(main2).with_data_parallel(
+        loss_name=loss2.name)
+    exe2 = fluid.Executor()
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        dp, = exe2.run(compiled, feed=feed, fetch_list=[loss2])
+        w_dp = np.asarray(scope2.get('cp_w'))
+    np.testing.assert_allclose(np.asarray(dp).ravel(),
+                               np.asarray(plain).ravel(), rtol=1e-5)
+    np.testing.assert_allclose(w_dp, w_plain, rtol=1e-5, atol=1e-7)
