@@ -150,3 +150,47 @@ def test_def_use_validation_names_op_and_var():
     with pytest.raises(ValueError, match='never_written'):
         exe.run(main, feed={'x': np.zeros((1, 2), 'float32')},
                 fetch_list=[y])
+
+
+def test_clone_for_test_freezes_dropout_and_bn():
+    """clone(for_test=True): dropout becomes identity, batch_norm uses
+    the running statistics (not batch stats), optimizer ops dropped —
+    the reference's train/eval program split."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[4], dtype='float32')
+            h = fluid.layers.dropout(
+                x, 0.5, dropout_implementation='upscale_in_train')
+            h = fluid.layers.fc(h, 4, bias_attr=False,
+                                param_attr=fluid.ParamAttr(
+                                    name='cf_w',
+                                    initializer=fluid.initializer.
+                                    Constant(1.0)))
+            h = fluid.layers.batch_norm(h)
+            loss = fluid.layers.reduce_mean(h)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    # optimizer/backward ops dropped from the clone
+    main_types = [op.type for op in main.global_block().ops]
+    test_types = [op.type for op in test_prog.global_block().ops]
+    assert '__backward__' in main_types and 'sgd' in main_types
+    assert '__backward__' not in test_types and 'sgd' not in test_types
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4).astype('float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # eval runs are DETERMINISTIC (dropout off): two runs identical
+        a, = exe.run(test_prog, feed={'x': xv}, fetch_list=[loss])
+        b, = exe.run(test_prog, feed={'x': xv}, fetch_list=[loss])
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # train runs are stochastic through dropout
+        t1, = exe.run(main, feed={'x': xv}, fetch_list=[loss])
+        # BN in the eval clone normalizes with running stats: feeding a
+        # SHIFTED batch changes the output mean (batch-stat BN would
+        # renormalize it away)
+        c, = exe.run(test_prog, feed={'x': xv + 5.0}, fetch_list=[loss])
+        assert abs(float(np.asarray(c)) - float(np.asarray(a))) > 1.0
