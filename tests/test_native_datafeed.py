@@ -122,3 +122,52 @@ def test_open_files_readers_do_not_alias(tmp_path):
     v2 = layers.io.read_file(r2)
     assert v1[0] is not v2[0]
     assert v1[0].name != v2[0].name
+
+
+def test_py_reader_training_pipeline():
+    """py_reader end-to-end: decorate a paddle reader, start, drive a
+    train loop via next_feed until StopIteration, reset and run a second
+    epoch (parity: reference py_reader usage pattern)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            reader = layers.io.py_reader(
+                capacity=8, shapes=[[-1, 4], [-1, 1]],
+                dtypes=['float32', 'int64'], name='pyr')
+            x, lbl = layers.io.read_file(reader)
+            p = layers.fc(x, 2, act='softmax')
+            loss = layers.reduce_mean(layers.cross_entropy(p, lbl))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        for _ in range(5):
+            xv = rng.rand(6, 4).astype('float32')
+            yv = (xv.sum(1, keepdims=True) > 2).astype('int64')
+            yield xv, yv
+
+    reader.decorate_paddle_reader(batches)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for epoch in range(2):
+            reader.start()
+            steps = 0
+            while True:
+                try:
+                    feed = reader.next_feed()
+                except StopIteration:
+                    break
+                lv, = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+                steps += 1
+            assert steps == 5
+            reader.reset()
+    assert len(losses) == 10
+    assert losses[-1] < losses[0]  # it actually trains
