@@ -237,3 +237,39 @@ def test_random_crop_shape_and_content():
     r0 = out[0, 0, 0]
     expect = r0 + np.arange(4)[:, None] * 8 + np.arange(4)[None, :]
     np.testing.assert_allclose(out[0], expect)
+
+
+def test_cumsum_attr_combinations():
+    """exclusive/reverse attribute grid vs numpy (reference cumsum_op)."""
+    x = np.array([[1., 2., 3., 4.]], 'float32')
+    cases = {
+        (False, False): np.array([[1., 3., 6., 10.]]),
+        (True, False): np.array([[0., 1., 3., 6.]]),
+        (False, True): np.array([[10., 9., 7., 4.]]),
+        (True, True): np.array([[9., 7., 4., 0.]]),
+    }
+    for (excl, rev), want in cases.items():
+        out = _impl('cumsum')(
+            None, {'X': jnp.asarray(x)},
+            {'axis': -1, 'exclusive': excl, 'reverse': rev})['Out']
+        np.testing.assert_allclose(np.asarray(out), want,
+                                   err_msg='excl=%s rev=%s' % (excl, rev))
+
+
+def test_pad2d_reflect_and_edge_modes():
+    x = np.arange(9, dtype='float32').reshape(1, 1, 3, 3)
+    for mode in ('reflect', 'edge'):
+        out = _impl('pad2d')(
+            None, {'X': jnp.asarray(x)},
+            {'paddings': [1, 1, 1, 1], 'mode': mode})['Out']
+        ref = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)], mode=mode)
+        np.testing.assert_allclose(np.asarray(out), ref, err_msg=mode)
+
+
+def test_label_smooth_numeric():
+    oh = np.eye(4, dtype='float32')[[1, 3]]
+    out = _impl('label_smooth')(
+        None, {'X': jnp.asarray(oh)}, {'epsilon': 0.1})
+    got = np.asarray(list(out.values())[0])
+    ref = oh * 0.9 + 0.1 / 4
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
