@@ -244,3 +244,45 @@ def test_fused_label_smooth_ce_matches_explicit_chain():
     lb = rng.randint(0, V, (B, T, 1)).astype('int64')
     a, b = _run([fused, explicit], {'lg': lv, 'lb': lb})
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_nets_scaled_dot_product_attention_numeric():
+    """nets.scaled_dot_product_attention vs a numpy reference (single
+    and multi-head)."""
+    rng = np.random.RandomState(0)
+    B, T, D, H = 2, 5, 8, 2
+    qv = rng.randn(B, T, D).astype('float32')
+    kv = rng.randn(B, T, D).astype('float32')
+    vv = rng.randn(B, T, D).astype('float32')
+
+    def np_sdpa(q, k, v, heads):
+        dh = D // heads
+        qh = q.reshape(B, T, heads, dh).transpose(0, 2, 1, 3)
+        kh = k.reshape(B, T, heads, dh).transpose(0, 2, 1, 3)
+        vh = v.reshape(B, T, heads, dh).transpose(0, 2, 1, 3)
+        s = (qh * dh ** -0.5) @ kh.transpose(0, 1, 3, 2)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        w = e / e.sum(-1, keepdims=True)
+        return (w @ vh).transpose(0, 2, 1, 3).reshape(B, T, D)
+
+    q = layers.data('q', shape=[T, D], dtype='float32')
+    k = layers.data('k', shape=[T, D], dtype='float32')
+    v = layers.data('v', shape=[T, D], dtype='float32')
+    outs = [fluid.nets.scaled_dot_product_attention(q, k, v, num_heads=h)
+            for h in (1, H)]
+    res = _run(outs, {'q': qv, 'k': kv, 'v': vv})
+    for got, heads in zip(res, (1, H)):
+        np.testing.assert_allclose(got, np_sdpa(qv, kv, vv, heads),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_nets_img_conv_group_shapes():
+    img = layers.data('icg', shape=[3, 16, 16], dtype='float32')
+    out = fluid.nets.img_conv_group(
+        img, conv_num_filter=[8, 8], pool_size=2, pool_stride=2,
+        conv_padding=1, conv_filter_size=3, conv_act='relu',
+        conv_with_batchnorm=True, pool_type='max')
+    got, = _run([out], {'icg': np.random.RandomState(1).rand(
+        2, 3, 16, 16).astype('float32')})
+    assert got.shape == (2, 8, 8, 8)   # the VGG conv_block shape
+    assert np.isfinite(got).all()
