@@ -59,6 +59,13 @@ def test_batch_and_drop_last():
     ('imdb', None),
     ('imikolov', None),
     ('movielens', None),
+    ('conll05', None),
+    ('sentiment', None),
+    ('wmt14', None),
+    ('wmt16', None),
+    ('mq2007', None),
+    ('flowers', None),
+    ('voc2012', None),
 ])
 def test_dataset_generators_yield(mod, shape_check):
     import importlib
@@ -69,8 +76,14 @@ def test_dataset_generators_yield(mod, shape_check):
         it = m.train(m.word_dict())
     elif mod == 'imikolov':
         it = m.train(m.build_dict(), 5)
-    elif mod == 'movielens':
+    elif mod == 'conll05':
+        it = m.test()
+    elif mod == 'sentiment':
         it = m.train()
+    elif mod == 'wmt14':
+        it = m.train(30000)
+    elif mod == 'wmt16':
+        it = m.train(3000, 3000)
     else:
         it = m.train()
     first = next(iter(it()))
