@@ -51,10 +51,14 @@ class DataFeeder(object):
                         (s.reshape(-1) if s.ndim == 1 else s for s in seqs)]
                 feed[name] = create_lod_tensor([s for s in seqs])
             else:
-                arr = np.asarray(col, dtype=dtype)
-                arr = arr.reshape((len(rows),) + tuple(
-                    int(abs(d)) for d in shape))
-                feed[name] = arr
+                tshape = tuple(int(abs(d)) for d in shape)
+                # each element reshapes to the slot shape INDIVIDUALLY
+                # (reference DataToLoDTensorConverter semantics): rows
+                # may arrive flat (mnist 784) or already shaped
+                elems = [np.asarray(c, dtype=dtype).reshape(tshape)
+                         for c in col]
+                feed[name] = (np.stack(elems) if elems else
+                              np.zeros((0,) + tshape, dtype))
         return feed
 
     def feed_parallel(self, iterable, num_places=None):

@@ -123,3 +123,37 @@ def test_weighted_average():
     wa.add(value=2.0, weight=1)
     wa.add(value=4.0, weight=3)
     assert abs(wa.eval() - 3.5) < 1e-9      # (2 + 12) / 4
+
+
+def test_data_feeder_dense_and_lod_slots():
+    """DataFeeder converts row tuples into the executor feed dict:
+    dense slots batch+reshape+cast; lod slots become padded+lengths."""
+    from paddle_tpu import layers
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            img = layers.data('df_img', shape=[1, 4, 4], dtype='float32')
+            lbl = layers.data('df_lbl', shape=[1], dtype='int64')
+            seq = layers.data('df_seq', shape=[1], dtype='float32',
+                              lod_level=1)
+            pooled = layers.sequence_pool(seq, 'sum')
+            total = layers.reduce_sum(img) + layers.reduce_sum(pooled)
+    feeder = fluid.DataFeeder([img, lbl, seq], program=main)
+    rows = [
+        (np.ones(16), 3, [1.0, 2.0, 3.0]),       # flat image, ragged seq
+        (np.zeros((1, 4, 4)), 7, [4.0]),
+    ]
+    feed = feeder.feed(rows)
+    assert feed['df_img'].shape == (2, 1, 4, 4)
+    assert feed['df_img'].dtype == np.float32
+    assert feed['df_lbl'].shape == (2, 1)
+    assert feed['df_lbl'].dtype == np.int64
+    lod = feed['df_seq']
+    assert lod.lengths.tolist() == [3, 1]
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        t, p = exe.run(main, feed=feed, fetch_list=[total, pooled])
+    np.testing.assert_allclose(np.asarray(p).ravel(), [6.0, 4.0])
+    np.testing.assert_allclose(float(np.asarray(t)), 16.0 + 10.0)
