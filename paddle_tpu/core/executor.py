@@ -294,8 +294,12 @@ def _analyze(block, feed_names, fetch_names):
 
 
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
-           out_shardings_for=None):
-    """Build the jitted step function for (program, feeds, fetches)."""
+           out_shardings_for=None, check_nan=False):
+    """Build the jitted step function for (program, feeds, fetches).
+    check_nan compiles a fused all-finite flag over fetches+updates INTO
+    the executable (per-array host checks measured >30x slower through
+    the device tunnel — see PERF.md); run_fn then returns a third
+    output, one bool scalar."""
     import jax
     import jax.numpy as jnp
 
@@ -363,7 +367,14 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                 raise ValueError('fetch var %s was never computed' % n)
             fetches.append(env[n])
         updates = {n: env[n] for n in writeback if n in env}
-        return fetches, updates
+        if not check_nan:
+            return fetches, updates
+        ok = jnp.asarray(True)
+        for v in itertools.chain(fetches, updates.values()):
+            if hasattr(v, 'dtype') and jnp.issubdtype(v.dtype,
+                                                      jnp.inexact):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v)))
+        return fetches, updates, ok
 
     jit_kwargs = {}
     if donate and writeback:
@@ -393,9 +404,10 @@ class Executor(object):
         # nan/inf debug guard (SURVEY §2.8; parity: the reference's global
         # FLAGS_check_nan_inf, which makes every op kernel assert finite
         # outputs).  Whole-block lowering has no per-op boundary, so the
-        # check runs on everything that leaves the executable: fetches and
-        # written-back persistables — same detection point a user can act
-        # on, one device->host scalar per array.
+        # check covers everything that leaves the executable — fetches and
+        # written-back persistables — as ONE fused all-finite scalar
+        # compiled into the step; the per-array naming pass runs only
+        # when that flag trips.
         if check_nan is None:
             check_nan = os.environ.get('FLAGS_check_nan_inf', '') in (
                 '1', 'true', 'True')
@@ -461,13 +473,14 @@ class Executor(object):
         fetch_names = tuple(self._resolve_fetch(fetch_list))
 
         key = (id(program), program._version, feed_names, fetch_names,
-               scope._serial)
+               scope._serial, self.check_nan)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             # the cached tuple keeps a strong ref to `program` so its id()
             # (part of the key) can never be recycled by a new Program
             entry = _lower(program, feed_names, fetch_names,
-                           donate=True, mesh=self.mesh) + (program,)
+                           donate=True, mesh=self.mesh,
+                           check_nan=self.check_nan) + (program,)
             if use_program_cache:
                 self._cache[key] = entry
         fn, params_in, writeback = entry[:3]
@@ -485,31 +498,37 @@ class Executor(object):
             # program's annotated layout.  Target shardings are cached per
             # lowering entry, and device_put is skipped once the written-
             # back arrays already carry the right sharding (steady state).
-            targets = self._shard_targets.get(key)
+            targets = self._shard_targets.get(key[:-1])
             if targets is None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 spec = program._sharding
                 targets = {n: NamedSharding(self.mesh, spec.get(n, P()))
                            for n in params_in}
-                self._shard_targets[key] = targets
+                self._shard_targets[key[:-1]] = targets
             params = {n: (v if getattr(v, 'sharding', None) == targets[n]
                           else jax.device_put(v, targets[n]))
                       for n, v in params.items()}
 
-        counter = self._run_counter.get(key, 0)
-        self._run_counter[key] = counter + 1
+        # the rng stream is keyed WITHOUT check_nan so toggling the debug
+        # flag mid-training does not restart dropout masks
+        ctr_key = key[:-1]
+        counter = self._run_counter.get(ctr_key, 0)
+        self._run_counter[ctr_key] = counter + 1
         seed = np.uint32((program.random_seed * 1000003 + counter)
                          & 0xffffffff)
 
-        fetches, updates = fn(params,
-                              {n: feed_vals[n] for n in feed_names},
-                              seed)
+        result = fn(params,
+                    {n: feed_vals[n] for n in feed_names},
+                    seed)
+        fetches, updates = result[0], result[1]
         # write back BEFORE the nan check: params were donated, so the old
         # scope arrays are dead — raising first would leave the scope
         # holding deleted buffers right when the user wants to inspect it
         for n, v in updates.items():
             scope.vars[n] = v
-        if self.check_nan:
+        if self.check_nan and not bool(result[2]):
+            # fused in-executable flag tripped: per-array pass to NAME
+            # the culprits (slow, but only runs on actual failure)
             self._assert_finite(itertools.chain(
                 zip(fetch_names, fetches), updates.items()))
         if return_numpy:
@@ -519,19 +538,30 @@ class Executor(object):
     @staticmethod
     def _assert_finite(named_arrays):
         import jax.numpy as jnp
-        bad = []
+        named = []
+        flags = []
         for n, v in named_arrays:
             try:
-                if not bool(jnp.all(jnp.isfinite(v))):
-                    bad.append(n)
+                flags.append(jnp.all(jnp.isfinite(v)))   # async dispatch
+                named.append(n)
             except TypeError:
                 continue  # non-numeric (e.g. tensor arrays) — skip
-        if bad:
-            raise RuntimeError(
-                'check_nan: non-finite values (nan/inf) detected after this '
-                'step in: %s. Typical causes: exploding gradients (try '
-                'gradient clipping or a lower LR), log/div of zero, or '
-                'uninitialized feeds.' % ', '.join(sorted(bad)))
+        if not flags:
+            return
+        # ONE host sync for the fused verdict — per-array host round
+        # trips made check_nan >30x slower through the tunnel (PERF.md);
+        # the naming pass below only runs on failure
+        ok = flags[0]
+        for f in flags[1:]:
+            ok = jnp.logical_and(ok, f)
+        if bool(ok):
+            return
+        bad = [n for n, f in zip(named, flags) if not bool(f)]
+        raise RuntimeError(
+            'check_nan: non-finite values (nan/inf) detected after this '
+            'step in: %s. Typical causes: exploding gradients (try '
+            'gradient clipping or a lower LR), log/div of zero, or '
+            'uninitialized feeds.' % ', '.join(sorted(bad)))
 
 
 class _CompiledProgramBase(object):
