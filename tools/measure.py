@@ -1,0 +1,195 @@
+"""One-shot measurement harnesses behind PERF.md's numbers.
+
+    python tools/measure.py decompose     # step-time split by model surgery
+    python tools/measure.py longctx       # llama long-context train steps
+    python tools/measure.py attn          # pallas-vs-composed attention grad
+    python tools/measure.py soak          # 500-step stability/convergence
+
+Run on a live chip; every harness prints its table and exits.  These
+are the scripts that produced the round-4 PERF.md sections — kept
+runnable so future rounds re-measure instead of trusting stale numbers.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _sync(x):
+    return np.asarray(x)
+
+
+def _timed_loop(exe, main, feed, loss, steps=30):
+    import jax
+    feed = {k: jax.device_put(v) for k, v in feed.items()}
+    for _ in range(3):
+        o, = exe.run(main, feed=feed, fetch_list=[loss])
+    _sync(o)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        o, = exe.run(main, feed=feed, fetch_list=[loss],
+                     return_numpy=False)
+    _sync(o)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def decompose():
+    """Forward / backward / optimizer / CE split (PERF.md
+    'Step-time decomposition')."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.models import transformer as tr
+    B, T, V = 32, 256, 32000
+    feeds = tr.synthetic_batch(np.random.RandomState(0), B, T)
+
+    def run(tag, build):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                loss = build()
+        main.set_amp(True)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ms = _timed_loop(exe, main, feeds, loss)
+        print('%-28s %7.2f ms' % (tag, ms), flush=True)
+        return ms
+
+    def tf(**kw):
+        out = tr.transformer(V, V, max_len=T, n_layer=6, n_head=8,
+                             d_model=512, d_inner=2048, dropout=0.0,
+                             use_flash=True, **kw)
+        return out
+
+    run('fwd only', lambda: tf(is_train=False)['loss'])
+
+    def with_opt(opt):
+        def build():
+            out = tf()
+            opt().minimize(out['loss'])
+            return out['loss']
+        return build
+    run('fwd+bwd+SGD', with_opt(lambda: fluid.optimizer.SGD(1e-4)))
+    run('fwd+bwd+Adam', with_opt(lambda: fluid.optimizer.Adam(1e-4)))
+
+    def no_ce():
+        out = tf()
+        loss = layers.reduce_mean(out['logits'])
+        fluid.optimizer.Adam(1e-4).minimize(loss)
+        return loss
+    run('fwd+bwd+Adam, no CE', no_ce)
+
+
+def longctx():
+    """llama long-context train steps (PERF.md 'Long-context llama')."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import llama
+    cfg = dict(vocab=32000, d_model=1024, n_layer=8, n_head=16,
+               n_kv_head=4, d_ffn=2816, theta=500000.0, max_len=4096)
+    for T, B in ((4096, 2), (8192, 1)):
+        c = dict(cfg, max_len=T)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                out = llama.build(c, lr=1e-4)
+        main.set_amp(True)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        feed = llama.make_batch(
+            [rng.randint(3, 32000, (T + 1,)) for _ in range(B)], T)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ms = _timed_loop(exe, main, feed, out['loss'], steps=10)
+        print('llama T=%5d B=%d: %8.0f tok/s (%.1f ms/step)'
+              % (T, B, B * T / ms * 1e3, ms), flush=True)
+
+
+def attn():
+    """pallas vs composed attention fwd+grad (PERF.md crossover table)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import attention as att
+    rng = np.random.RandomState(0)
+
+    def bench_grad(fn, args, iters=10):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: (fn(q, k, v).astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2)))
+        out = g(*args)
+        _sync(out[0][0, 0, 0, 0])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = g(*args)
+        _sync(out[0][0, 0, 0, 0])
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    for T in (2048, 4096, 8192):
+        q, k, v = (jnp.asarray(rng.randn(2, 8, T, 64), jnp.bfloat16)
+                   for _ in range(3))
+        att._FWD_PALLAS_MIN_T = 0
+        att._BWD_PALLAS_SCORE_BYTES = 0
+        tp = bench_grad(
+            lambda q, k, v: att.flash_attention(q, k, v, causal=True),
+            (q, k, v))
+        att._FWD_PALLAS_MIN_T = 1 << 30
+        tc = bench_grad(
+            lambda q, k, v: att.flash_attention(q, k, v, causal=True),
+            (q, k, v))
+        print('T=%5d: pallas %7.2f ms   composed %7.2f ms' % (T, tp, tc),
+              flush=True)
+
+
+def soak():
+    """500-step stability/convergence (PERF.md 'Sustained-training')."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tr
+    B, T, V = 32, 128, 8000
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            out = tr.build(src_vocab=V, trg_vocab=V, max_len=T, n_layer=4,
+                           n_head=8, d_model=256, d_inner=1024,
+                           dropout=0.1, lr=1.0, warmup_steps=400,
+                           use_flash=True)
+    main.set_amp(True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+
+    def copy_batch():
+        rows = []
+        for _ in range(B):
+            n = rng.randint(T // 2, T - 1)
+            s = rng.randint(3, V, (n,))
+            rows.append((np.concatenate([s, [1]]),
+                         np.concatenate([[0], s]),
+                         np.concatenate([s, [1]])))
+        return tr.make_batch(rows, T)
+
+    pool = [{k: jax.device_put(v) for k, v in copy_batch().items()}
+            for _ in range(50)]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        t0 = time.perf_counter()
+        for step in range(500):
+            lv, = exe.run(main, feed=pool[step % 50],
+                          fetch_list=[out['loss']], return_numpy=False)
+            if (step + 1) % 100 == 0:
+                print('step %d loss %.3f (%.1fs/100)' %
+                      (step + 1, float(_sync(lv).ravel()[0]),
+                       time.perf_counter() - t0), flush=True)
+                t0 = time.perf_counter()
+
+
+if __name__ == '__main__':
+    harness = sys.argv[1] if len(sys.argv) > 1 else 'decompose'
+    {'decompose': decompose, 'longctx': longctx,
+     'attn': attn, 'soak': soak}[harness]()
