@@ -47,6 +47,7 @@ __all__ = [
     'sequence_first_step', 'sequence_last_step', 'sequence_slice',
     'sequence_reshape', 'sequence_scatter', 'sequence_mask',
     'sequence_enumerate', 'sequence_concat', 'sequence_reverse',
+    'sequence_erase',
     'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'chunk_eval',
     'flash_attention', 'ring_attention', 'rms_norm', 'rope',
     'linear_chain_crf', 'crf_decoding', 'one_hot', 'group_norm',
@@ -1474,10 +1475,30 @@ def sequence_slice(input, offset, length, name=None):
     helper = LayerHelper('sequence_slice', name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     out_len = helper.create_variable_for_type_inference('int32')
-    helper.append_op(type='sequence_slice',
-                     inputs={'X': input, 'Offset': offset, 'Length': length},
+    ins = {'X': input, 'Offset': offset, 'Length': length}
+    lv = _len_var(input)
+    if lv is not None:  # source lengths, so the op can clamp requests
+        ins['XLength'] = lv
+    helper.append_op(type='sequence_slice', inputs=ins,
                      outputs={'Out': out, 'OutLength': out_len}, attrs={})
-    # the output sequence's lengths are the requested slice lengths
+    # the output sequence's lengths are the requested slice lengths,
+    # clamped to the tokens actually available past each row's offset
+    out.lod_level = max(input.lod_level, 1)
+    out.lod_length_name = out_len.name
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    """Remove every occurrence of `tokens` from each sequence,
+    compacting the survivors left (parity: reference
+    sequence_erase_op.cc; the reference reaches it through
+    edit_distance's ignored_tokens)."""
+    helper = LayerHelper('sequence_erase', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='sequence_erase', inputs=_seq_inputs(input),
+                     outputs={'Out': out, 'OutLength': out_len},
+                     attrs={'tokens': list(tokens)})
     out.lod_level = max(input.lod_level, 1)
     out.lod_length_name = out_len.name
     return out
@@ -1591,6 +1612,9 @@ def ctc_greedy_decoder(input, blank, name=None):
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    """The reference erases ignored_tokens with two sequence_erase ops
+    before the distance op (nn.py edit_distance); here the op itself
+    squeezes them (ops/nn.py), so the attr just forwards."""
     helper = LayerHelper('edit_distance')
     out = helper.create_variable_for_type_inference('float32')
     seq_num = helper.create_variable_for_type_inference('int64')
@@ -1603,7 +1627,8 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None):
         ins['RefsLength'] = llv
     helper.append_op(type='edit_distance', inputs=ins,
                      outputs={'Out': out, 'SequenceNum': seq_num},
-                     attrs={'normalized': normalized})
+                     attrs={'normalized': normalized,
+                            'ignored_tokens': list(ignored_tokens or [])})
     return out, seq_num
 
 

@@ -85,23 +85,35 @@ def _eligible(w, idx_flat):
             w.dtype in (jnp.float32, jnp.bfloat16))
 
 
-@jax.custom_vjp
+@functools.lru_cache(maxsize=None)
+def _make_kernel_gather(V, D, dtype_name):
+    """Per-(shape, dtype) custom_vjp gather.  The table shape/dtype are
+    closed over as STATIC values so the vjp residuals hold only arrays —
+    a dtype object in residuals is not a valid JAX type and would make
+    tracing under jax.grad raise (and silently reroute every training
+    step to the jnp.take fallback)."""
+    w_dtype = jnp.dtype(dtype_name)
+
+    @jax.custom_vjp
+    def kernel_gather(w, idx_flat):
+        interpret = jax.default_backend() != 'tpu'
+        return _pallas_gather(w, idx_flat, interpret)
+
+    def fwd(w, idx_flat):
+        return kernel_gather(w, idx_flat), (idx_flat,)
+
+    def bwd(res, g):
+        (idx_flat,) = res
+        dw = jnp.zeros((V, D), w_dtype).at[idx_flat].add(g.astype(w_dtype))
+        return dw, np.zeros(idx_flat.shape, jax.dtypes.float0)
+
+    kernel_gather.defvjp(fwd, bwd)
+    return kernel_gather
+
+
 def _kernel_gather(w, idx_flat):
-    interpret = jax.default_backend() != 'tpu'
-    return _pallas_gather(w, idx_flat, interpret)
-
-
-def _kernel_gather_fwd(w, idx_flat):
-    return _kernel_gather(w, idx_flat), (idx_flat, w.shape, w.dtype)
-
-
-def _kernel_gather_bwd(res, g):
-    idx_flat, w_shape, w_dtype = res
-    dw = jnp.zeros(w_shape, w_dtype).at[idx_flat].add(g.astype(w_dtype))
-    return dw, np.zeros(idx_flat.shape, jax.dtypes.float0)
-
-
-_kernel_gather.defvjp(_kernel_gather_fwd, _kernel_gather_bwd)
+    V, D = w.shape
+    return _make_kernel_gather(V, D, jnp.dtype(w.dtype).name)(w, idx_flat)
 
 _warned = False
 

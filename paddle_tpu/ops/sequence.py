@@ -25,9 +25,9 @@ def _mask(x, length):
     return m.reshape((B, T) + (1,) * (x.ndim - 2))
 
 
-def _length_or_full(ins, x):
-    if 'Length' in ins and ins['Length'] is not None:
-        return ins['Length']
+def _length_or_full(ins, x, key='Length'):
+    if key in ins and ins[key] is not None:
+        return ins[key]
     return jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
 
 
@@ -154,15 +154,20 @@ def sequence_mask(ctx, ins, attrs):
 def sequence_slice(ctx, ins, attrs):
     x, offset, length = ins['X'], ins['Offset'], ins['Length']
     T = x.shape[1]
-    off = offset.reshape(-1)
+    off = offset.reshape(-1).astype(jnp.int32)
     t = jnp.arange(T)[None, :]
     idx = jnp.minimum(off[:, None] + t, T - 1)
     out = jnp.take_along_axis(
         x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
-    new_len = length.reshape(-1).astype(jnp.int32)
+    # the reference enforces offset + length <= seq_len
+    # (sequence_slice_op.h); with static shapes we clamp instead so a
+    # request past the row's valid end can never report padding (or the
+    # clamp-duplicated last frame) as valid tokens.
+    row_len = _length_or_full(ins, x, key='XLength').astype(jnp.int32)
+    new_len = jnp.clip(length.reshape(-1).astype(jnp.int32),
+                       0, jnp.maximum(row_len - off, 0))
     m = (t < new_len[:, None]).reshape(
         (x.shape[0], T) + (1,) * (x.ndim - 2))
-    # the output's lengths are the REQUESTED slice lengths, not X's
     return {'Out': out * m.astype(x.dtype), 'OutLength': new_len}
 
 
@@ -269,7 +274,7 @@ def sequence_erase(ctx, ins, attrs):
                     jnp.zeros_like(compacted))
     if squeeze:
         out = out[..., None]
-    return {'Out': out, 'Length': new_len}
+    return {'Out': out, 'OutLength': new_len}
 
 
 # --------------------------------------------------------------- RNNs

@@ -350,8 +350,25 @@ def py_func_op(ctx, ins, attrs):
     dtypes = [jax.dtypes.canonicalize_dtype(np.dtype(convert_dtype(d)))
               for d in attrs['out_dtypes']]
     batch = xs[0].shape[0] if xs and getattr(xs[0], 'ndim', 0) else 1
+
+    def _static_shape(shp):
+        # -1 means "the batch dim" and is only meaningful at axis 0;
+        # pure_callback needs every other dim static at trace time.
+        out = []
+        for ax, s in enumerate(shp):
+            if s == -1:
+                if ax != 0:
+                    raise ValueError(
+                        'py_func out_shape %r: -1 is only supported at '
+                        'axis 0 (the batch dim); XLA needs static shapes '
+                        'for every other dim' % (list(shp),))
+                out.append(batch)
+            else:
+                out.append(s)
+        return tuple(out)
+
     result = tuple(
-        jax.ShapeDtypeStruct(tuple(batch if s == -1 else s for s in shp), d)
+        jax.ShapeDtypeStruct(_static_shape(shp), d)
         for shp, d in zip(attrs['out_shapes'], dtypes))
 
     def host_fwd(*arrays):

@@ -17,7 +17,12 @@ def _force_kernel(monkeypatch):
     monkeypatch.setattr(gather, '_MIN_ROWS', _BLOCK)
 
 
-def test_gather_parity_and_grad():
+def test_gather_parity_and_grad(monkeypatch):
+    from paddle_tpu.ops import gather
+    calls = []
+    real = gather._pallas_gather
+    monkeypatch.setattr(gather, '_pallas_gather',
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
     rng = np.random.RandomState(0)
     w = jnp.asarray(rng.randn(640, 128), jnp.float32)
     idx = jnp.asarray(rng.randint(0, 640, (_BLOCK * 2,)), jnp.int32)
@@ -25,8 +30,14 @@ def test_gather_parity_and_grad():
     out = embedding_gather(w, idx)
     np.testing.assert_allclose(np.asarray(out), np.asarray(w)[idx],
                                rtol=1e-6)
-    # gradient: scatter-add with duplicate indices
+    # gradient: scatter-add with duplicate indices.  The kernel must
+    # actually engage under jax.grad (a dtype object in the vjp
+    # residuals used to raise at trace time and silently reroute every
+    # training step to the jnp.take fallback — ADVICE r4).
+    n_fwd_calls = len(calls)
+    assert n_fwd_calls > 0
     g = jax.grad(lambda w: (embedding_gather(w, idx) ** 2).sum())(w)
+    assert len(calls) > n_fwd_calls, 'kernel path did not run under grad'
     gr = jax.grad(lambda w: (jnp.take(w, idx, axis=0) ** 2).sum())(w)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr), rtol=1e-5)
 

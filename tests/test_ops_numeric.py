@@ -420,4 +420,4 @@ def test_sequence_erase_compacts_and_relengths():
         np.asarray(outs['Out']),
         [[7, 0, 0, 0, 0, 0],   # row0 [3,5,3,7]: erase 3s and 5s -> [7]
          [1, 2, 9, 0, 0, 0]])  # row1: erase 5s -> [1, 2, 9]
-    np.testing.assert_array_equal(np.asarray(outs['Length']), [1, 3])
+    np.testing.assert_array_equal(np.asarray(outs['OutLength']), [1, 3])

@@ -259,3 +259,44 @@ def test_sequence_enumerate_and_scatter():
     np.testing.assert_array_equal(
         ev[0], [[1, 2], [2, 3], [3, 4], [4, 0]])
     np.testing.assert_allclose(scv[0], [11., 1., 21., 1., 31.])
+
+
+def test_sequence_slice_clamps_past_end():
+    """Requests past a row's valid end must clamp: the reference
+    enforces offset + length <= seq_len (sequence_slice_op.h); here the
+    reported OutLength clamps so padding never leaks in as valid
+    tokens (ADVICE r4)."""
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=1)
+    off = layers.data('off', shape=[1], dtype='int64')
+    ln = layers.data('ln', shape=[1], dtype='int64')
+    sl = layers.sequence_slice(x, off, ln)
+    ssum = layers.sequence_pool(sl, 'sum')
+    last = layers.sequence_pool(sl, 'last')
+    exe = fluid.Executor()
+    # row0 [1,2,3]: offset 2, request 5 -> only 1 token available ([3])
+    # row1 [4,5]: offset 1, request 3 -> only 1 token ([5])
+    sv, sm, lv = exe.run(
+        feed={'x': _lod_feed(),
+              'off': np.array([[2], [1]], 'int64'),
+              'ln': np.array([[5], [3]], 'int64')},
+        fetch_list=[sl, ssum, last])
+    np.testing.assert_allclose(sm, [[3.], [5.]])   # no pad counted
+    np.testing.assert_allclose(lv, [[3.], [5.]])   # last valid, not pad
+
+
+def test_sequence_erase_layer_binds_lengths():
+    """Public layers.sequence_erase: compacts survivors, and the new
+    lengths flow to downstream consumers via lod_length_name."""
+    ids = layers.data('ids', shape=[1], dtype='int64', lod_level=1)
+    er = layers.sequence_erase(ids, tokens=[0, 2])
+    cnt = layers.sequence_pool(er, 'sum')    # sums only valid survivors
+    last = layers.sequence_pool(er, 'last')
+    exe = fluid.Executor()
+    rows = [np.array([[2], [7], [0], [9]], 'int64'),
+            np.array([[0], [0]], 'int64')]
+    ev, cv, lv = exe.run(feed={'ids': create_lod_tensor(rows)},
+                         fetch_list=[er, cnt, last])
+    np.testing.assert_array_equal(ev[0, :2, 0], [7, 9])  # compacted
+    np.testing.assert_allclose(cv[0], [16.])
+    np.testing.assert_allclose(lv[0], [9.])              # last survivor
+    np.testing.assert_allclose(cv[1], [0.])              # all erased
