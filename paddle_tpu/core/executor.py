@@ -231,7 +231,12 @@ def _exec_ops_plain(ops, op_offset, env, ectx, program):
                 _impl(_ctx, kw, _a))(ins)
         else:
             outs = impl(ctx, ins, op.attrs)
-        if use_amp and op.type in _AMP_CAST_OPS and outs:
+        # amp_keep_bf16: per-op opt-out of the cast-back policy for a
+        # GEMM whose consumers are bf16-tolerant (e.g. the logit
+        # projection feeding softmax_with_cross_entropy, which upcasts
+        # its reductions internally) — halves that [B, T, V] buffer
+        if use_amp and op.type in _AMP_CAST_OPS and outs and \
+                not op.attrs.get('amp_keep_bf16'):
             outs = {s: ([_amp_cast(v, jnp.float32) for v in vs]
                         if isinstance(vs, (list, tuple))
                         else _amp_cast(vs, jnp.float32))
@@ -337,12 +342,32 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                     'differentiate wrt parameters or feed vars' % missing)
             diff = {p: env0[p] for p in pnames}
             rest = {k: v for k, v in env0.items() if k not in diff}
+            # Prune fw's outputs to what the rest of the step actually
+            # reads.  Returning the whole env would make EVERY
+            # intermediate a vjp primal output carrying a dense zero
+            # cotangent through the transpose — measured on the per-HLO
+            # ledger (PERF.md r5): unused auxiliary outputs (op Softmax
+            # slots, norm statistics) kept whole [B, T, V]-scale
+            # forward+backward chains alive.
+            fw_keep = set(fetch_names) | set(writeback) | {loss_name}
+
+            def _collect_reads(op_list):
+                for op_after in op_list:
+                    fw_keep.update(op_after.input_names())
+                    # control-flow bodies read outer vars directly from
+                    # env (not through input slots) — recurse like
+                    # _analyze does
+                    sb = op_after.attrs.get('sub_block')
+                    if sb is not None:
+                        _collect_reads(program.block(sb).ops)
+
+            _collect_reads(ops[bw_idx + 1:])
 
             def fw(d):
                 env2 = dict(rest)
                 env2.update(d)
                 _exec_ops(ops[:bw_idx], 0, env2, ectx, program)
-                return env2
+                return {k: v for k, v in env2.items() if k in fw_keep}
 
             env_out, pullback = jax.vjp(fw, diff)
             if loss_name not in env_out:

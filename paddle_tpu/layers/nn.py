@@ -92,9 +92,13 @@ def _seq_inputs(x, extra=None):
 # ------------------------------------------------------------------ fc
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
-       act=None, is_test=False, name=None):
+       act=None, is_test=False, name=None, amp_keep_bf16=False):
     """Reference nn.py fc: y = act(x W + b); lowers to one MXU GEMM.
-    On padded sequence input [B, T, D] the weight applies per-token."""
+    On padded sequence input [B, T, D] the weight applies per-token.
+    amp_keep_bf16 (TPU extension): under AMP, keep the GEMM output in
+    bf16 instead of casting back to f32 — for projections whose
+    consumers upcast internally (softmax_with_cross_entropy), halving
+    the output buffer's HBM traffic in both directions of autodiff."""
     helper = LayerHelper('fc', input=input, param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = helper.input_dtype()
@@ -111,7 +115,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         tmp = helper.create_variable_for_type_inference(dtype)
         helper.append_op(type='mul', inputs={'X': input_var, 'Y': w},
                          outputs={'Out': tmp},
-                         attrs={'x_num_col_dims': ncd, 'y_num_col_dims': 1})
+                         attrs={'x_num_col_dims': ncd, 'y_num_col_dims': 1,
+                                'amp_keep_bf16': amp_keep_bf16})
         _copy_lod(input_var, tmp)
         mul_results.append(tmp)
     if len(mul_results) == 1:
@@ -721,10 +726,14 @@ def split(input, num_or_sections, dim=-1, name=None):
     return outs
 
 
-def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None,
+           amp_keep_bf16=False):
+    # amp_keep_bf16 (TPU extension): keep the GEMM output bf16 under AMP
+    # for consumers that tolerate it (attention interiors) — see fc
     return _simple('matmul', x, {'transpose_X': transpose_x,
                                  'transpose_Y': transpose_y,
-                                 'alpha': float(alpha)}, name,
+                                 'alpha': float(alpha),
+                                 'amp_keep_bf16': amp_keep_bf16}, name,
                    extra_ins={'Y': y})
 
 

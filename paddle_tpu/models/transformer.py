@@ -15,12 +15,14 @@ from paddle_tpu.param_attr import ParamAttr
 from paddle_tpu.initializer import Normal
 
 
-def _linear(x, size, name, bias=True):
+def _linear(x, size, name, bias=True, amp_keep_bf16=False, init=None):
     # Xavier init (the fluid fc default): keeps attention logits at O(1)
     # scale so gradients reach the encoder from step 0
     return layers.fc(x, size, num_flatten_dims=2,
-                     param_attr=ParamAttr(name=name + '_w'),
-                     bias_attr=ParamAttr(name=name + '_b') if bias else False)
+                     param_attr=ParamAttr(name=name + '_w',
+                                          initializer=init),
+                     bias_attr=ParamAttr(name=name + '_b') if bias else False,
+                     amp_keep_bf16=amp_keep_bf16)
 
 
 def multi_head_attention(q_in, kv_in, mask, d_model, n_head, dropout,
@@ -29,9 +31,33 @@ def multi_head_attention(q_in, kv_in, mask, d_model, n_head, dropout,
     """mask: [B, 1, Tq, Tk] additive (-1e9 on invalid); kv_lengths int [B]
     (used by the flash path, where pad is a suffix)."""
     d_head = d_model // n_head
-    q = _linear(q_in, d_model, name + '_q', bias=False)
-    k = _linear(kv_in, d_model, name + '_k', bias=False)
-    v = _linear(kv_in, d_model, name + '_v', bias=False)
+    # fused projections: self-attention projects q,k,v as ONE d x 3d
+    # GEMM (cross-attention fuses k,v as d x 2d) and splits the result.
+    # Measured ~parity end-to-end at B=32/T=256 (+0.2%, PERF.md r5) —
+    # XLA was already handling the three small GEMMs well — kept because
+    # it reads the activations once and is never slower.
+    # amp_keep_bf16 flow-through was ALSO measured for the block
+    # interior (q/k/v + scores + weights + context, and separately the
+    # ffn hidden): both lose ~0.5% — the f32 [B,H,T,T] residual copies
+    # the ledger flagged are cheaper than the extra converts the bf16
+    # interior induces around the f32 softmax statistics.  Cast-back
+    # stays the block-interior policy; only the logits projection flows
+    # (PERF.md r5).
+    # the fused [d, 3d] weight pins Xavier fans to the SEPARATE
+    # projections' (d, d) so each q/k/v slice keeps the exact init
+    # distribution of three unfused fc's (fan_out would otherwise
+    # triple and shrink the init std ~1.4x)
+    from paddle_tpu.initializer import Xavier
+    per_proj = Xavier(fan_in=d_model, fan_out=d_model)
+    if q_in is kv_in:
+        qkv = _linear(q_in, 3 * d_model, name + '_qkv', bias=False,
+                      init=per_proj)
+        q, k, v = layers.split(qkv, 3, dim=-1)
+    else:
+        q = _linear(q_in, d_model, name + '_q', bias=False)
+        kv = _linear(kv_in, 2 * d_model, name + '_kv', bias=False,
+                     init=per_proj)
+        k, v = layers.split(kv, 2, dim=-1)
 
     def split_heads(x):
         x = layers.reshape(x, [0, 0, n_head, d_head])
@@ -177,7 +203,13 @@ def transformer(src_vocab, trg_vocab, max_len=64, n_layer=6, n_head=8,
                             param_attr=ParamAttr(name='dec_post_ln_w'),
                             bias_attr=ParamAttr(name='dec_post_ln_b'))
 
-    logits = _linear(dec, trg_vocab, 'proj')            # [B, T, V]
+    # the [B, T, V] logits stay bf16 under AMP: their only consumer is
+    # the CE, whose reductions are internally f32, and the backward then
+    # carries a bf16 dlogits into the two big vocab GEMMs — this buffer
+    # is the largest in the model and was measured f32 in the per-HLO
+    # ledger (PERF.md r5)
+    logits = _linear(dec, trg_vocab, 'proj',            # [B, T, V]
+                     amp_keep_bf16=True)
     # fused label smoothing: the one_hot -> label_smooth -> soft-CE chain
     # would materialize two [B, T, V] f32 buffers (>1 GB at bench shapes);
     # the closed form needs only reductions over V

@@ -37,14 +37,85 @@ def cross_entropy(ctx, ins, attrs):
     return {'Y': out}
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _make_hard_ce(V, eps, ignore):
+    """Efficient hard-label CE with a hand-written vjp (per-HLO profile,
+    PERF.md r5): JAX autodiff of the logsumexp chain materialized the
+    dlogits cotangent as an f32 [B, T, V] buffer (1 GB at bench shapes)
+    plus a separate log_softmax backward reduction pass.  Here the
+    residuals are just (logits, label, lse[B,T,1]); the backward
+    computes dlogits = g * (softmax - (1-eps)*onehot - eps/V) in ONE
+    fused elementwise pass and emits it in the LOGITS dtype — bf16 when
+    the projection flows through under AMP, so the two backward GEMMs
+    read half the bytes.  Numerics: all reductions and the stored lse
+    are f32 regardless of logits dtype (same contract as before); the
+    bf16 rounding of dlogits is the same rounding the MXU applied to
+    the f32 cotangent anyway."""
+
+    @jax.custom_vjp
+    def ce(logits, lab):
+        return _fwd(logits, lab)[0]
+
+    def _fwd(logits, lab):
+        x = logits.astype(jnp.float32)
+        m = jnp.max(x, axis=-1, keepdims=True)
+        lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
+        # gather from the UNconverted logits: XLA can fuse a convert
+        # into reduce fusions but not into the gather's kCustom call, so
+        # take_along_axis(x, ...) forced a full f32 [B, T, V]
+        # materialization just to pick B*T scalars (per-HLO ledger,
+        # PERF.md r5); converting the picked values is identical math
+        tgt = jnp.take_along_axis(logits, lab, axis=-1).astype(jnp.float32)
+        if eps:
+            # (1-eps)*hard_ce + eps*(-mean logp), closed form
+            loss = lse - (1.0 - eps) * tgt - eps * jnp.mean(
+                x, axis=-1, keepdims=True)
+        else:
+            loss = lse - tgt
+        loss = jnp.where(lab == ignore, jnp.zeros_like(loss), loss)
+        return loss, (logits, lab, lse)
+
+    def bwd(res, g):
+        logits, lab, lse = res
+        x = logits.astype(jnp.float32)
+        p = jnp.exp(x - lse)
+        onehot = (jnp.arange(V) == lab).astype(jnp.float32)
+        d = p - (1.0 - eps) * onehot - (eps / V)
+        gz = jnp.where(lab == ignore, jnp.zeros_like(g),
+                       g.astype(jnp.float32))
+        dlogits = (gz * d).astype(logits.dtype)
+        return dlogits, np.zeros(lab.shape, jax.dtypes.float0)
+
+    ce.defvjp(_fwd, bwd)
+    return ce
+
+
 @register('softmax_with_cross_entropy')
 def softmax_with_cross_entropy(ctx, ins, attrs):
     # logsumexp in f32 (bf16 logits under AMP are fine — the reduction is
-    # not); Loss is always f32.  The f32 [.., V] logp persists to
-    # backward as a residual; dropping it via jax.checkpoint measured
-    # 19% slower end-to-end (PERF.md), available as PT_CE_REMAT=1.
+    # not); Loss is always f32.  Hard labels over the last axis take the
+    # custom-vjp fast path (_make_hard_ce); jax.checkpoint remat of the
+    # whole op measured 19% slower (PERF.md), kept behind PT_CE_REMAT=1.
     logits, label = ins['Logits'], ins['Label']
     axis = attrs.get('axis', -1)
+    ndim = logits.ndim
+    if not attrs.get('soft_label', False) and axis in (-1, ndim - 1):
+        lab = label
+        if lab.ndim == ndim - 1:
+            lab = jnp.expand_dims(lab, -1)
+        lab = lab.astype(jnp.int32)
+        ce = _make_hard_ce(int(logits.shape[-1]),
+                           float(attrs.get('label_smooth_eps', 0.0)),
+                           int(attrs.get('ignore_index', -100)))
+        loss = ce(logits, lab)
+        # derived lazily so an unused Softmax output DCEs away with its
+        # whole log_softmax chain (the common training case)
+        softmax = jnp.exp(jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1))
+        return {'Loss': loss, 'Softmax': softmax}
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
     if attrs.get('soft_label', False):
         loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis,

@@ -27,6 +27,9 @@ __all__ = ['shard_program_tp', 'annotate']
 # (regex on parameter name, spec factory given ndim)
 _RULES = [
     (re.compile(r'.*(_q|_k|_v)_w$'), lambda nd: P(None, 'model')),
+    # fused projections (transformer.py r5: q,k,v as one d x 3d GEMM /
+    # cross-attention k,v as d x 2d) — column-parallel like the parts
+    (re.compile(r'.*(_qkv|_kv)_w$'), lambda nd: P(None, 'model')),
     (re.compile(r'.*_o_w$'), lambda nd: P('model', None)),
     (re.compile(r'.*_fc1_w$'), lambda nd: P(None, 'model')),
     (re.compile(r'.*_fc1_b$'), lambda nd: P('model')),

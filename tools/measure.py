@@ -4,6 +4,8 @@
     python tools/measure.py longctx       # llama long-context train steps
     python tools/measure.py attn          # pallas-vs-composed attention grad
     python tools/measure.py soak          # 500-step stability/convergence
+    python tools/measure.py hlo           # per-HLO xplane ledger, bench step
+    python tools/measure.py allreduce     # psum/all-gather BW over the mesh
 
 Run on a live chip; every harness prints its table and exits.  These
 are the scripts that produced the round-4 PERF.md sections — kept
@@ -189,7 +191,232 @@ def soak():
                 t0 = time.perf_counter()
 
 
+def _hlo_category_map(hlo_text):
+    """Parse optimized HLO text into {instruction_name: category}.
+    Fusions are categorized by what their fused computation BODY
+    contains (a '%fusion.740' profiler event name says nothing about
+    whether it is a GEMM or elementwise glue)."""
+    import re
+    # '%name = <type> opcode(operands...' — the type can nest parens
+    # (tile/memory-space annotations like T(8,128) or S(1)), but the
+    # opcode is always the FIRST lowercase word directly followed by '('
+    inst_re = re.compile(r'^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*.*?'
+                         r'[\s)]([a-z][\w\-]*)\(')
+    # computation bodies: '%name (params) -> type {' ... instructions
+    comp_has = {}
+    cur, ops = None, set()
+    for line in hlo_text.splitlines():
+        m = re.match(r'(?:ENTRY\s+)?%?([\w.\-]+)\s*(?:\([^)]*\))?\s*'
+                     r'(?:->.*)?\{\s*$', line)
+        if m and not line.lstrip().startswith('%param'):
+            if cur is not None:
+                comp_has[cur] = ops
+            cur, ops = m.group(1), set()
+            continue
+        m = inst_re.match(line)
+        if m:
+            ops.add(m.group(2))
+    if cur is not None:
+        comp_has[cur] = ops
+
+    def body_cat(body_ops):
+        if 'dot' in body_ops:
+            return 'matmul'
+        if 'convolution' in body_ops:
+            return 'conv'
+        if 'scatter' in body_ops:
+            return 'scatter'
+        if 'gather' in body_ops or 'dynamic-slice' in body_ops:
+            return 'gather/slice'
+        if 'custom-call' in body_ops:
+            return 'custom-call (pallas)'
+        if 'reduce' in body_ops:
+            return 'reduce+elementwise'
+        return 'elementwise'
+
+    cat = {}
+    for line in hlo_text.splitlines():
+        m = inst_re.match(line)
+        if not m:
+            continue
+        name, opcode = m.group(1), m.group(2)
+        if opcode == 'fusion':
+            mc = re.search(r'calls=%?([\w.\-]+)', line)
+            body = comp_has.get(mc.group(1), set()) if mc else set()
+            cat[name] = body_cat(body)
+        elif opcode == 'dot':
+            cat[name] = 'matmul'
+        elif opcode == 'convolution':
+            cat[name] = 'conv'
+        elif opcode in ('copy', 'transpose', 'bitcast',
+                        'copy-start', 'copy-done'):
+            cat[name] = 'copy/transpose'
+        elif opcode == 'custom-call':
+            cat[name] = 'custom-call (pallas)'
+        elif opcode in ('all-reduce', 'all-gather', 'reduce-scatter',
+                        'collective-permute'):
+            cat[name] = 'collective'
+        else:
+            cat[name] = opcode
+    return cat
+
+
+def hlo(steps=10, top=30):
+    """Per-HLO ledger of the bench train step (PERF.md 'Where the MFU
+    ceiling actually is'): trace `steps` steps with jax.profiler, parse
+    the xplane with jax.profiler.ProfileData, aggregate the TensorCore
+    'XLA Ops' line (serialized sync ops — sums to the step wall) by
+    category via the after-optimizations HLO dump, and print the top
+    entries.  Async DMA ('Async XLA Ops') overlaps the sync timeline and
+    is reported separately, not summed in.  This is HLO granularity —
+    the evidence level the round-4 verdict asked for behind any 'the
+    gap is diffuse' claim."""
+    import glob
+    import tempfile
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tr
+    B, T, V = 32, 256, 32000
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            out = tr.build(src_vocab=V, trg_vocab=V, max_len=T, n_layer=6,
+                           n_head=8, d_model=512, d_inner=2048,
+                           dropout=0.0, use_flash=True)
+    main.set_amp(True)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    feed = tr.synthetic_batch(np.random.RandomState(0), B, T)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {k: jax.device_put(v) for k, v in feed.items()}
+        for _ in range(3):
+            lv, = exe.run(main, feed=feed, fetch_list=[out['loss']])
+        _sync(lv)
+        tmpdir = tempfile.mkdtemp(prefix='hlo_trace_')
+        with jax.profiler.trace(tmpdir):
+            for _ in range(steps):
+                lv, = exe.run(main, feed=feed, fetch_list=[out['loss']],
+                              return_numpy=False)
+            _sync(lv)
+        # optimized HLO for fusion->category mapping: re-lower+compile
+        # the SAME jitted step (deterministic naming; the axon tunnel
+        # compiles remotely, so --xla_dump_to can't reach the files)
+        entry = next(e for k, e in exe._cache.items() if k[0] == id(main))
+        fn, params_in = entry[0], entry[1]
+        params = {n: scope.vars[n] for n in params_in}
+        hlo_text = fn.lower(params, feed, np.uint32(0)).compile().as_text()
+        open('/tmp/hlo_step.txt', 'w').write(hlo_text)
+    paths = glob.glob(os.path.join(tmpdir, '**', '*.xplane.pb'),
+                      recursive=True)
+    if not paths:
+        print('no xplane.pb written under %s' % tmpdir)
+        return
+    cat_map = _hlo_category_map(hlo_text)
+    pd = jax.profiler.ProfileData.from_file(paths[0])
+    per_op, async_ns, step_ns, nsteps = {}, 0, 0, 0
+    for plane in pd.planes:
+        if not plane.name.startswith('/device:TPU'):
+            continue
+        for line in plane.lines:
+            if line.name == 'XLA Ops':
+                for ev in line.events:
+                    per_op[ev.name] = per_op.get(ev.name, 0) + ev.duration_ns
+            elif line.name == 'Async XLA Ops':
+                async_ns += sum(ev.duration_ns for ev in line.events)
+            elif line.name == 'Steps':
+                for ev in line.events:
+                    step_ns += ev.duration_ns
+                    nsteps += 1
+    if not per_op:
+        print('no sync XLA Ops events found')
+        return
+
+    def _cat(event_name):
+        iname = event_name.split(' = ')[0].strip().lstrip('%')
+        return cat_map.get(iname, 'unmapped')
+
+    total = sum(per_op.values())
+    print('%d distinct sync HLO ops; TensorCore busy %.2f ms/step; '
+          'step wall %.2f ms (x%d); async DMA span %.2f ms/step (overlapped)'
+          % (len(per_op), total / 1e6 / steps,
+             step_ns / 1e6 / max(nsteps, 1), nsteps, async_ns / 1e6 / steps))
+    cats = {}
+    for name, ns in per_op.items():
+        c = _cat(name)
+        cats[c] = cats.get(c, 0) + ns
+    print('\n-- category totals (sync TensorCore time) --')
+    for c, ns in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print('%-28s %8.3f ms/step  %5.1f%%'
+              % (c, ns / 1e6 / steps, 100.0 * ns / total))
+    only = os.environ.get('PT_HLO_FILTER')  # show one category's ops
+    print('\n-- top %d sync HLO ops%s --'
+          % (top, ' [%s]' % only if only else ''))
+    shown = 0
+    for name, ns in sorted(per_op.items(), key=lambda kv: -kv[1]):
+        if only and _cat(name) != only:
+            continue
+        print('%7.3f ms/step %5.1f%%  [%s]  %s'
+              % (ns / 1e6 / steps, 100.0 * ns / total, _cat(name),
+                 name[:100]))
+        shown += 1
+        if shown >= top:
+            break
+
+
+def allreduce():
+    """Collective bandwidth over the local mesh (BASELINE.json headline
+    metric #3; the path the reference serves with NCCL —
+    nccl_helper.h).  Measures psum (allreduce), all-gather and
+    reduce-scatter bus bandwidth; prints null single-chip (one chip has
+    no ICI to measure) so the harness degrades gracefully."""
+    import json
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    devs = jax.devices()
+    if len(devs) < 2:
+        print(json.dumps({'devices': len(devs), 'allreduce_gbps': None,
+                          'all_gather_gbps': None,
+                          'reduce_scatter_gbps': None,
+                          'note': 'single device: no interconnect to '
+                                  'measure; run on a mesh'}))
+        return
+    mesh = Mesh(np.array(devs), ('x',))
+    nd = len(devs)
+    results = {'devices': nd}
+    for nbytes in (1 << 20, 16 << 20, 64 << 20):
+        n = nbytes // 4 // nd * nd
+        x = jnp.ones((n,), jnp.float32)
+
+        def run(body, out_specs):
+            f = jax.jit(shard_map(body, mesh=mesh, in_specs=P('x'),
+                                  out_specs=out_specs))
+            f(x).block_until_ready()
+            t0 = time.perf_counter()
+            iters = 10
+            for _ in range(iters):
+                o = f(x)
+            o.block_until_ready()
+            return (time.perf_counter() - t0) / iters
+
+        # ring-algorithm bus-bandwidth accounting (the convention NCCL
+        # tests print): allreduce moves 2(n-1)/n, gather/scatter (n-1)/n
+        dt = run(lambda s: jax.lax.psum(s, 'x'), P(None))
+        results['allreduce_gbps_%dMB' % (nbytes >> 20)] = round(
+            2 * (nd - 1) / nd * n * 4 / dt / 1e9, 2)
+        dt = run(lambda s: jax.lax.all_gather(s, 'x', tiled=True), P(None))
+        results['all_gather_gbps_%dMB' % (nbytes >> 20)] = round(
+            (nd - 1) / nd * n * 4 / dt / 1e9, 2)
+        dt = run(lambda s: jax.lax.psum_scatter(s, 'x', tiled=True), P('x'))
+        results['reduce_scatter_gbps_%dMB' % (nbytes >> 20)] = round(
+            (nd - 1) / nd * n * 4 / dt / 1e9, 2)
+    print(json.dumps(results))
+
+
 if __name__ == '__main__':
     harness = sys.argv[1] if len(sys.argv) > 1 else 'decompose'
     {'decompose': decompose, 'longctx': longctx,
-     'attn': attn, 'soak': soak}[harness]()
+     'attn': attn, 'soak': soak, 'hlo': hlo,
+     'allreduce': allreduce}[harness]()
