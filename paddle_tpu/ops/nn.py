@@ -5,6 +5,8 @@ group_norm_op, softmax_op, dropout_op, lrn_op, interpolate_op, etc.
 Convs/pools use lax.conv_general_dilated / lax.reduce_window in NCHW — XLA
 lays them out for the MXU; no cuDNN-style algo selection needed.
 """
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -206,9 +208,38 @@ def batch_norm(ctx, ins, attrs):
             bias.reshape(bshape)
         return {'Y': y.astype(x.dtype), 'MeanOut': mean, 'VarianceOut': var,
                 'SavedMean': m, 'SavedVariance': v}
-    m = jnp.mean(xf, axis=axes)
-    v = jnp.mean(jnp.square(xf - m.reshape(bshape)), axis=axes)
-    y = (xf - m.reshape(bshape)) * (
+    # one-pass statistics (f32 accumulation): the two-pass
+    # mean(square(x - m)) form reads the conv-sized activation TWICE
+    # per BN — at ResNet bench shapes the BN statistic fusions were
+    # ~20% of the step (per-HLO ledger, PERF.md r5).  The sums are
+    # SHIFTED by a per-channel pilot value c (the first element) so the
+    # E[d^2] - E[d]^2 subtraction never catastrophically cancels when
+    # |mean| >> std; the shift is analytically a no-op (stop_gradient'd)
+    # and fuses into the same single read.  Residual risk: a pilot
+    # element ~4000 sigma away from its group mean can still cancel —
+    # PT_TWO_PASS_NORM=1 restores the exact two-pass form.
+    if os.environ.get('PT_TWO_PASS_NORM', '0') == '1':
+        m = jnp.mean(xf, axis=axes)
+        v = jnp.mean(jnp.square(xf - m.reshape(bshape)), axis=axes)
+        y = (xf - m.reshape(bshape)) * (
+            scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + eps)) + \
+            bias.reshape(bshape)
+        new_mean = lax.stop_gradient(momentum * mean + (1 - momentum) * m)
+        new_var = lax.stop_gradient(momentum * var + (1 - momentum) * v)
+        return {'Y': y.astype(x.dtype), 'MeanOut': new_mean,
+                'VarianceOut': new_var, 'SavedMean': m,
+                'SavedVariance': v}
+    c = lax.stop_gradient(xf[tuple(
+        slice(None) if i == ch_axis else slice(0, 1)
+        for i in range(x.ndim))])
+    d = xf - c
+    md = jnp.mean(d, axis=axes, keepdims=True)
+    v = jnp.maximum(
+        jnp.mean(jnp.square(d), axis=axes, keepdims=True)
+        - jnp.square(md), 0.0)
+    m = (md + c).reshape(x.shape[ch_axis])
+    v = v.reshape(x.shape[ch_axis])
+    y = (d - md) * (
         scale.reshape(bshape) * lax.rsqrt(v.reshape(bshape) + eps)) + \
         bias.reshape(bshape)
     new_mean = lax.stop_gradient(momentum * mean + (1 - momentum) * m)
@@ -224,9 +255,24 @@ def layer_norm(ctx, ins, attrs):
     eps = attrs.get('epsilon', 1e-5)
     axes = tuple(range(begin, x.ndim))
     xf = x.astype(jnp.float32)  # f32 statistics; output in input dtype
-    m = jnp.mean(xf, axis=axes, keepdims=True)
-    v = jnp.mean(jnp.square(xf - m), axis=axes, keepdims=True)
-    y = (xf - m) * lax.rsqrt(v + eps)
+    # shifted one-pass statistics like batch_norm above: one read, and
+    # the per-row pilot shift bounds the E[d^2]-E[d]^2 cancellation
+    # (PT_TWO_PASS_NORM=1 restores the exact two-pass form)
+    if os.environ.get('PT_TWO_PASS_NORM', '0') == '1':
+        m = jnp.mean(xf, axis=axes, keepdims=True)
+        v = jnp.mean(jnp.square(xf - m), axis=axes, keepdims=True)
+        y = (xf - m) * lax.rsqrt(v + eps)
+    else:
+        c = lax.stop_gradient(xf[tuple(
+            slice(None) if i < begin else slice(0, 1)
+            for i in range(x.ndim))])
+        d = xf - c
+        md = jnp.mean(d, axis=axes, keepdims=True)
+        v = jnp.maximum(
+            jnp.mean(jnp.square(d), axis=axes, keepdims=True)
+            - jnp.square(md), 0.0)
+        m = md + c
+        y = (d - md) * lax.rsqrt(v + eps)
     norm_shape = x.shape[begin:]
     if 'Scale' in ins:
         y = y * ins['Scale'].reshape(norm_shape)

@@ -5,6 +5,7 @@
     python tools/measure.py attn          # pallas-vs-composed attention grad
     python tools/measure.py soak          # 500-step stability/convergence
     python tools/measure.py hlo           # per-HLO xplane ledger, bench step
+    python tools/measure.py convprobe     # conv fwd/dx/dw microbench
     python tools/measure.py allreduce     # psum/all-gather BW over the mesh
 
 Run on a live chip; every harness prints its table and exits.  These
@@ -270,23 +271,28 @@ def hlo(steps=10, top=30):
     entries.  Async DMA ('Async XLA Ops') overlaps the sync timeline and
     is reported separately, not summed in.  This is HLO granularity —
     the evidence level the round-4 verdict asked for behind any 'the
-    gap is diffuse' claim."""
+    gap is diffuse' claim.  PT_HLO_MODEL=resnet profiles the ResNet-50
+    bench step instead; PT_HLO_FILTER=<category> lists one category."""
     import glob
     import tempfile
     import jax
     import paddle_tpu as fluid
-    from paddle_tpu.models import transformer as tr
-    B, T, V = 32, 256, 32000
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main, startup):
-        with fluid.unique_name.guard():
-            out = tr.build(src_vocab=V, trg_vocab=V, max_len=T, n_layer=6,
-                           n_head=8, d_model=512, d_inner=2048,
-                           dropout=0.0, use_flash=True)
-    main.set_amp(True)
+    if os.environ.get('PT_HLO_MODEL') == 'resnet':
+        from paddle_tpu.models import resnet
+        main, startup, out, feed = resnet.bench_program()
+    else:
+        from paddle_tpu.models import transformer as tr
+        B, T, V = 32, 256, 32000
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                out = tr.build(src_vocab=V, trg_vocab=V, max_len=T,
+                               n_layer=6, n_head=8, d_model=512,
+                               d_inner=2048, dropout=0.0, use_flash=True)
+        feed = tr.synthetic_batch(np.random.RandomState(0), B, T)
+        main.set_amp(True)
     exe = fluid.Executor()
     scope = fluid.Scope()
-    feed = tr.synthetic_batch(np.random.RandomState(0), B, T)
     with fluid.scope_guard(scope):
         exe.run(startup)
         feed = {k: jax.device_put(v) for k, v in feed.items()}
@@ -364,6 +370,89 @@ def hlo(steps=10, top=30):
             break
 
 
+def convprobe():
+    """Forward / input-grad / filter-grad conv microbench at
+    representative ResNet-50 shapes (round-4 only probed the forward;
+    the 0.148-vs-0.20 MFU gap question is whether backward convs run
+    slower than the ~20%-of-peak forward ceiling).  bf16, B=128,
+    NCHW like the model."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    B = 128
+    dn = jax.lax.conv_dimension_numbers((1, 1, 1, 1), (1, 1, 1, 1),
+                                        ('NCHW', 'OIHW', 'NCHW'))
+    shapes = [  # (Cin, Cout, HW, k, stride) mid/late-net ResNet shapes
+        (64, 64, 56, 3, 1),
+        (128, 128, 28, 3, 1),
+        (256, 256, 14, 3, 1),
+        (512, 512, 7, 3, 1),
+        (64, 256, 56, 1, 1),
+        (256, 128, 56, 1, 2),
+    ]
+    print('conv probe (bf16, B=%d, NCHW); TFLOP/s vs 197 peak' % B)
+    for cin, cout, hw, k, s in shapes:
+        x = jnp.asarray(rng.randn(B, cin, hw, hw), jnp.bfloat16)
+        w = jnp.asarray(rng.randn(cout, cin, k, k), jnp.bfloat16)
+        pad = 'SAME' if k > 1 else 'VALID'
+
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (s, s), pad, dimension_numbers=dn)
+
+        out_hw = hw // s
+        flops = 2.0 * B * cout * cin * k * k * out_hw * out_hw
+
+        def timed(f, lead, *args):
+            """Differential in-jit timing.  Three tunnel/compiler traps,
+            each hit while building this (PERF.md r5): (1) a synchronous
+            dispatch through the axon tunnel costs ~60 ms regardless of
+            work, so the op runs N times inside ONE jitted fori_loop at
+            two N values and the delta/(N2-N1) cancels the constant;
+            (2) the loop body must consume a FULL reduction of the
+            output — consuming one element let XLA slice the probed
+            conv down to computing a single output pixel; (3) the
+            iteration-decorrelating perturbation must use a NORMAL f32
+            constant — 1e-45 is a denormal, which TPU flushes to zero
+            and XLA folds away, hoisting the op out of the loop."""
+
+            def many_fn(n):
+                @jax.jit
+                def many(lead, args):
+                    def body(_, acc):
+                        pj = (lead.astype(jnp.float32) *
+                              (1.0 + acc * 1e-10)).astype(lead.dtype)
+                        o = f(pj, *args)
+                        return acc + jnp.sum(o.astype(jnp.float32)) * 1e-20
+                    return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+                return many
+
+            def once(m):
+                t0 = time.perf_counter()
+                _sync(m(lead, args))
+                return time.perf_counter() - t0
+
+            times = {}
+            for n in (10, 110):
+                m = many_fn(n)
+                _sync(m(lead, args))  # compile
+                times[n] = min(once(m) for _ in range(3))
+            return (times[110] - times[10]) / 100.0
+
+        tf_ = timed(lambda x, w: conv(x, w), x, w)
+        _, vjp_x = jax.vjp(lambda x: conv(x, w), x)
+        ct = jnp.ones((B, cout, out_hw, out_hw), jnp.bfloat16)
+        gx = timed(lambda c: vjp_x(c)[0], ct)
+        _, vjp_w = jax.vjp(lambda w: conv(x, w), w)
+        gw = timed(lambda c: vjp_w(c)[0], ct)
+        print('C%4d->%4d %3dx%-3d k%d s%d | fwd %6.2fms %5.1fTF | '
+              'dx %6.2fms %5.1fTF | dw %6.2fms %5.1fTF'
+              % (cin, cout, hw, hw, k, s,
+                 tf_ * 1e3, flops / tf_ / 1e12,
+                 gx * 1e3, flops / gx / 1e12,
+                 gw * 1e3, flops / gw / 1e12), flush=True)
+
+
 def allreduce():
     """Collective bandwidth over the local mesh (BASELINE.json headline
     metric #3; the path the reference serves with NCCL —
@@ -419,4 +508,4 @@ if __name__ == '__main__':
     harness = sys.argv[1] if len(sys.argv) > 1 else 'decompose'
     {'decompose': decompose, 'longctx': longctx,
      'attn': attn, 'soak': soak, 'hlo': hlo,
-     'allreduce': allreduce}[harness]()
+     'convprobe': convprobe, 'allreduce': allreduce}[harness]()

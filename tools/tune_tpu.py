@@ -67,17 +67,9 @@ def bench_resnet(B, steps=10):
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
 
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main, startup):
-        with fluid.unique_name.guard():
-            out = resnet.build(data_shape=(3, 224, 224), class_dim=1000,
-                               depth=50, lr=0.1)
-    main.set_amp(True)
+    main, startup, out, feed = resnet.bench_program(B=B)
     exe = fluid.Executor()
     scope = fluid.Scope()
-    rng = np.random.RandomState(0)
-    feed = {'data': rng.rand(B, 3, 224, 224).astype('float32'),
-            'label': rng.randint(0, 1000, (B, 1)).astype('int64')}
     with fluid.scope_guard(scope):
         exe.run(startup)
         feed = {k: jax.device_put(v) for k, v in feed.items()}
