@@ -479,6 +479,9 @@ class Executor(object):
             if isinstance(v, LoDTensor):
                 feed_vals[k] = v.padded
                 feed_vals[k + '@LENGTH'] = v.lengths
+                if v.outer_lengths is not None and \
+                        block.has_var(k + '@OUTERLEN'):
+                    feed_vals[k + '@OUTERLEN'] = v.outer_lengths
             elif hasattr(v, 'devices'):
                 # already a device array: pass through zero-copy (a feed
                 # uploaded once with jax.device_put is NOT round-tripped

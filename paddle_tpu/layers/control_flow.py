@@ -647,20 +647,42 @@ class StaticRNN(_RecurrentBase):
             # shapes are static here, so resolve the batch dim at build
             # time and emit a plain fill_constant
             bs = list(shape)
-            if batch_ref.shape is None or \
-                    batch_ref.shape[ref_batch_dim_idx] in (-1, None):
-                raise ValueError(
-                    'StaticRNN.memory needs a statically-shaped batch_ref '
-                    '(got %s)' % (batch_ref.shape,))
-            bs[init_batch_dim_idx] = int(batch_ref.shape[ref_batch_dim_idx])
+            if batch_ref.shape is not None and \
+                    batch_ref.shape[ref_batch_dim_idx] not in (-1, None):
+                # statically-known batch: a plain fill_constant suffices
+                bs[init_batch_dim_idx] = int(
+                    batch_ref.shape[ref_batch_dim_idx])
+                boot = self._parent.create_var(
+                    name=unique_name.generate(self.helper.name + '_boot'),
+                    dtype=batch_ref.dtype, shape=tuple(bs))
+                self._parent.append_op(
+                    type='fill_constant',
+                    inputs={}, outputs={'Out': boot},
+                    attrs={'shape': bs, 'value': float(init_value),
+                           'dtype': batch_ref.dtype},
+                    infer_shape=False)
+                return self.memory(init=boot)
+            # batch dim is -1 (default append_batch_size programs): boot
+            # via fill_constant_batch_size_like, like DynamicRNN.  The
+            # boot op runs in the PARENT block, so when batch_ref is the
+            # step-local slice, size off its parent [T, B, ...] sequence
+            # (batch at axis 1) instead.
+            ref, ref_dim = batch_ref, ref_batch_dim_idx
+            for ipt, seq in self.inputs:
+                if batch_ref is ipt:
+                    ref, ref_dim = seq, 1
+                    break
+            bs[init_batch_dim_idx] = -1
             boot = self._parent.create_var(
                 name=unique_name.generate(self.helper.name + '_boot'),
                 dtype=batch_ref.dtype, shape=tuple(bs))
             self._parent.append_op(
-                type='fill_constant',
-                inputs={}, outputs={'Out': boot},
+                type='fill_constant_batch_size_like',
+                inputs={'Input': ref}, outputs={'Out': boot},
                 attrs={'shape': bs, 'value': float(init_value),
-                       'dtype': batch_ref.dtype},
+                       'dtype': batch_ref.dtype,
+                       'input_dim_idx': ref_dim,
+                       'output_dim_idx': init_batch_dim_idx},
                 infer_shape=False)
             return self.memory(init=boot)
         return self._make_memory(init)

@@ -8,7 +8,7 @@ companion `<name>@LENGTH` int32 vector fed automatically from a LoDTensor.
 """
 from ..core.framework import default_main_program, default_startup_program
 from ..core.layer_helper import LayerHelper
-from ..core.lod import LENGTH_SUFFIX
+from ..core.lod import LENGTH_SUFFIX, OUTER_SUFFIX
 
 __all__ = ['data', 'py_reader', 'shuffle', 'batch', 'double_buffer',
            'read_file', 'open_files', 'random_data_generator', 'load',
@@ -35,6 +35,12 @@ def data(name, shape, dtype='float32', lod_level=0, type=None,
         block.create_var(name=name + LENGTH_SUFFIX, shape=[-1],
                          dtype='int32', is_data=True, stop_gradient=True)
         var.lod_length_name = name + LENGTH_SUFFIX
+    if lod_level > 1:
+        # lengths-of-lengths companion (nested LoD): #inner sequences
+        # per outer group, fed automatically from a 2-level LoDTensor
+        block.create_var(name=name + OUTER_SUFFIX, shape=[-1],
+                         dtype='int32', is_data=True, stop_gradient=True)
+        var.lod_outer_length_name = name + OUTER_SUFFIX
     return var
 
 

@@ -1907,23 +1907,35 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None,
     """
     from . import control_flow as cf
     helper = LayerHelper('beam_search_decode', name=name)
+
+    def _stacked(v):
+        """TensorArray -> stack its steps; a plain 3-D (T, R, 1) /
+        (T, R) var is already the stacked dense form."""
+        if isinstance(v, cf._TensorArray):
+            return stack(v.vars, axis=0)
+        return v
     ids_vars = ids.vars if isinstance(ids, cf._TensorArray) else [ids]
     sc_vars = scores.vars if isinstance(scores, cf._TensorArray) else [scores]
-    ids_stk = stack(ids_vars, axis=0)
-    sc_stk = stack(sc_vars, axis=0)
-    inputs = {'Ids': ids_stk, 'Scores': sc_stk}
+    inputs = {'Ids': _stacked(ids), 'Scores': _stacked(scores)}
     if parents is not None:
-        p_vars = (parents.vars if isinstance(parents, cf._TensorArray)
-                  else [parents])
-        inputs['Parents'] = stack(p_vars, axis=0)
+        inputs['Parents'] = _stacked(parents)
     sentence_ids = helper.create_variable_for_type_inference(
         ids_vars[0].dtype)
     sentence_scores = helper.create_variable_for_type_inference(
         sc_vars[0].dtype)
+    out_len = helper.create_variable_for_type_inference('int32')
+    out_outer = helper.create_variable_for_type_inference('int32')
     helper.append_op(type='beam_search_decode', inputs=inputs,
                      outputs={'SentenceIds': sentence_ids,
-                              'SentenceScores': sentence_scores},
+                              'SentenceScores': sentence_scores,
+                              'OutLength': out_len,
+                              'OutOuterLength': out_outer},
                      attrs={'beam_size': beam_size, 'end_id': end_id})
+    # reference emits 2-level LoD: source -> hypotheses -> tokens
+    for v in (sentence_ids, sentence_scores):
+        v.lod_level = 2
+        v.lod_length_name = out_len.name
+        v.lod_outer_length_name = out_outer.name
     return sentence_ids, sentence_scores
 
 

@@ -502,9 +502,14 @@ def beam_search_decode(ctx, ins, attrs):
     """Backtrace beam-search steps into full hypotheses.
 
     Ref: paddle/fluid/operators/beam_search_decode_op.cc.  The reference
-    walks LoD back-pointers on the CPU; here the per-step parent indices are
-    an explicit dense input and the walk is a lax.scan from the last step —
-    one compiled gather chain, shapes static.
+    walks LoD back-pointers on the CPU and emits a 2-LEVEL LoDTensor
+    (level 0: source -> its beam_size hypotheses; level 1: hypothesis ->
+    its tokens).  Here the per-step parent indices are an explicit dense
+    input and the walk is a lax.scan from the last step — one compiled
+    gather chain, shapes static — and the same two levels come back as
+    the padded+lengths companions: OutLength[R] (tokens per hypothesis,
+    INCLUDING its end token, reference convention) and OutOuterLength
+    [R/beam_size] (constant beam_size fan-out per source).
 
     Inputs: Ids (T, R, 1), Scores (T, R, 1), Parents (T, R) int32.
     Outputs: SentenceIds (R, T), SentenceScores (R, T); positions after a
@@ -525,4 +530,16 @@ def beam_search_decode(ctx, ins, attrs):
 
     _, (toks, scs) = jax.lax.scan(step, jnp.arange(R), jnp.arange(T),
                                   reverse=True)
-    return {'SentenceIds': toks.T, 'SentenceScores': scs.T}
+    toks, scs = toks.T, scs.T        # (R, T)
+    end_id = attrs.get('end_id', 0)
+    beam = int(attrs.get('beam_size', 1))
+    is_end = toks == end_id
+    # tokens per hypothesis including its first end token (reference
+    # keeps the end token in the emitted sentence)
+    first_end = jnp.argmax(is_end, axis=1)
+    length = jnp.where(is_end.any(axis=1), first_end + 1, T).astype(
+        jnp.int32)
+    n_src = max(R // max(beam, 1), 1)
+    outer = jnp.full((n_src,), R // n_src, jnp.int32)
+    return {'SentenceIds': toks, 'SentenceScores': scs,
+            'OutLength': length, 'OutOuterLength': outer}

@@ -332,8 +332,32 @@ def shuffle_channel(ctx, ins, attrs):
 
 @register('where_index')
 def where_index(ctx, ins, attrs):
-    raise NotImplementedError(
-        'where_index has data-dependent output shape; not XLA-compatible')
+    """Coordinates of nonzero elements (parity: reference
+    where_index_op — its output shape is data-dependent, which XLA
+    cannot compile).  TPU-native fixed-K contract (the multiclass_nms
+    pattern): attr `max_count` (default: condition size, always exact)
+    bounds the result; outputs are Out int64 [K, rank] with valid rows
+    FIRST in row-major scan order and -1 padding after, plus Count
+    int64 [1] with the true number of nonzeros.  Count > max_count
+    means truncation: callers picking a smaller K own that bound."""
+    cond = ins['Condition']
+    rank = max(cond.ndim, 1)
+    flat = (cond != 0).reshape(-1)
+    n = flat.shape[0]
+    K = int(attrs.get('max_count') or n)
+    pos = jnp.arange(n)
+    # stable compaction: valid positions first, in scan order
+    order = jnp.argsort(jnp.where(flat, pos, pos + n))[:K]
+    valid = jnp.arange(K) < flat.sum()
+    coords = []
+    rem = order
+    for d in range(rank - 1, -1, -1):
+        dim = cond.shape[d] if cond.ndim else 1
+        coords.append(rem % dim)
+        rem = rem // dim
+    out = jnp.stack(coords[::-1], axis=1).astype(jnp.int64)
+    out = jnp.where(valid[:, None], out, -1)
+    return {'Out': out, 'Count': flat.sum().reshape(1).astype(jnp.int64)}
 
 
 @register('py_func')

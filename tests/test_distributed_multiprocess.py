@@ -12,7 +12,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 _WORKER = r"""
 import os, sys
@@ -56,7 +55,9 @@ def _free_port():
     return p
 
 
-def test_two_process_psum(tmp_path):
+def _spawn_and_collect(timeout=150):
+    """Launch the 2-process psum; returns (ok, outs) where ok=False
+    means the bootstrap timed out (processes killed)."""
     port = _free_port()
     eps = '127.0.0.1:%d,127.0.0.1:%d' % (port, port + 1)
     procs = []
@@ -76,16 +77,33 @@ def test_two_process_psum(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        pytest.skip('jax.distributed bootstrap timed out in this '
-                    'environment')
-    for rank, (p, out) in enumerate(zip(procs, outs)):
+        return False, outs
+    return True, list(zip(procs, outs))
+
+
+def test_two_process_psum(tmp_path):
+    """No skip escape hatch (VERDICT r4 #8): a flaky coordination-service
+    bind gets bounded retries with fresh ports, then the test FAILS —
+    this is the only real multi-process collective coverage."""
+    attempts = []
+    for attempt in range(3):
+        ok, res = _spawn_and_collect()
+        if ok:
+            break
+        attempts.append('attempt %d: bootstrap timed out' % attempt)
+    else:
+        raise AssertionError(
+            'jax.distributed bootstrap timed out on all retries:\n%s'
+            % '\n'.join(attempts))
+    for rank, (p, out) in enumerate(res):
         assert p.returncode == 0, 'rank %d failed:\n%s' % (rank, out)
         assert 'RANK_OK' in out, out
+    outs = [out for _, out in res]
     # 2 procs x 2 local devices = 4 global: psum of arange(4) = 6
     assert 'RANK_OK 0 2 6.0' in outs[0], outs[0]
     assert 'RANK_OK 1 2 6.0' in outs[1], outs[1]

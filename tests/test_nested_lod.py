@@ -1,0 +1,104 @@
+"""Nested (2-level) LoD: lengths-of-lengths companions, converters, the
+feed->op->fetch round trip, and beam_search_decode's reference-shaped
+2-level output.  Model: reference python/paddle/fluid/lod_tensor.py
+docstring examples (2-level sentence->word nesting) and
+beam_search_decode_op.cc (source->hypothesis->token)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.lod import LoDTensor, create_lod_tensor
+
+
+def test_two_level_create_from_packed_reference_convention():
+    """The reference's documented 2-level example shape: 2 outer groups
+    holding [2, 1] inner sequences of word counts [2, 3, 1] -> packed
+    data of 6 words, offset LoD [[0, 2, 3], [0, 2, 5, 6]]."""
+    packed = np.arange(6).reshape(6, 1).astype('int64')
+    t = create_lod_tensor(packed, [[2, 1], [2, 3, 1]], None)
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 3, 1]]
+    assert t.lod() == [[0, 2, 3], [0, 2, 5, 6]]
+    assert t.padded.shape == (3, 3, 1)
+    # inner rows split at offsets 0,2,5,6
+    np.testing.assert_array_equal(t.rows()[0][:, 0], [0, 1])
+    np.testing.assert_array_equal(t.rows()[1][:, 0], [2, 3, 4])
+    np.testing.assert_array_equal(t.rows()[2][:, 0], [5])
+    # nested view groups rows [0,1] under group 0, [2] under group 1
+    nested = t.nested_rows()
+    assert [len(g) for g in nested] == [2, 1]
+    # packed round-trip is exact
+    back, lens = t.to_packed()
+    np.testing.assert_array_equal(back, packed)
+    assert lens == [[2, 1], [2, 3, 1]]
+
+
+def test_two_level_create_from_nested_list():
+    data = [[[1, 2], [3, 4, 5]], [[6]]]
+    t = create_lod_tensor(data, [[2, 1], [2, 3, 1]], None)
+    assert t.lod_level == 2
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 3, 1]]
+    np.testing.assert_array_equal(t.flatten_rows()[:, 0], [1, 2, 3, 4, 5, 6])
+
+
+def test_one_level_unchanged():
+    t = create_lod_tensor(np.arange(5).reshape(5, 1), [[3, 2]], None)
+    assert t.lod_level == 1
+    assert t.lod() == [[0, 3, 5]]
+    # reference list convention: flat list of sequences + 1-level lens
+    t2 = create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]], None)
+    np.testing.assert_array_equal(t2.flatten_rows()[:, 0], [1, 2, 3, 4, 5])
+
+
+def test_two_level_feed_op_fetch_roundtrip():
+    """A 2-level LoDTensor feeds (padded + @LENGTH + @OUTERLEN), a
+    masked sequence op consumes the inner lengths, and the outer
+    grouping is fetchable to rebuild the 2-level result."""
+    x = layers.data('x', shape=[1], dtype='float32', lod_level=2)
+    pooled = layers.sequence_pool(x, 'sum')   # sums valid tokens per row
+    outer = x.block.var('x@OUTERLEN')
+    inner = x.block.var('x@LENGTH')
+    t = create_lod_tensor(
+        np.array([[1.], [2.], [3.], [4.], [5.], [6.]], 'float32'),
+        [[2, 1], [2, 3, 1]], None)
+    exe = fluid.Executor()
+    pv, ov, iv = exe.run(feed={'x': t}, fetch_list=[pooled, outer, inner])
+    np.testing.assert_allclose(pv.ravel(), [3., 12., 6.])  # per-inner sums
+    # rebuild the 2-level structure on the host side
+    out = LoDTensor(pv.reshape(-1, 1, 1), np.ones(3, np.int32), ov)
+    assert [len(g) for g in out.nested_rows()] == [2, 1]
+    np.testing.assert_array_equal(iv, [2, 3, 1])
+
+
+def test_beam_search_decode_two_level_output():
+    """Hand-checked backtrace (reference beam_search_decode_op.cc
+    semantics): 1 source x 2 beams, 3 steps; hypothesis 0 ends at step 2
+    (end token kept -> 3 tokens), hypothesis 1 never ends (3 tokens);
+    level-0 fan-out is beam_size per source."""
+    T, R = 3, 2
+    ids = layers.data('ids', shape=[T, R, 1], dtype='int64',
+                      append_batch_size=False, stop_gradient=True)
+    scores = layers.data('sc', shape=[T, R, 1], dtype='float32',
+                         append_batch_size=False, stop_gradient=True)
+    sid, ssc = layers.beam_search_decode(ids, scores, beam_size=2, end_id=0)
+    assert sid.lod_level == 2
+    lens = sid.block.var(sid.lod_length_name)
+    outer = sid.block.var(sid.lod_outer_length_name)
+    # step tokens: t0 [5, 7], t1 [9, 8], t2 [0(end), 6]; identity parents
+    feed = {'ids': np.array([[[5], [7]], [[9], [8]], [[0], [6]]], 'int64'),
+            'sc': np.ones((T, R, 1), 'float32')}
+    rid, rlen, router = fluid.Executor().run(
+        feed=feed, fetch_list=[sid, lens, outer])
+    np.testing.assert_array_equal(rid, [[5, 9, 0], [7, 8, 6]])
+    np.testing.assert_array_equal(rlen, [3, 3])   # end token INCLUDED
+    np.testing.assert_array_equal(router, [2])    # 1 source x beam 2
+    # early end: hypothesis 0 ends at step 0 -> length 1
+    feed2 = {'ids': np.array([[[0], [7]], [[0], [8]], [[0], [6]]], 'int64'),
+             'sc': np.ones((T, R, 1), 'float32')}
+    rid2, rlen2 = fluid.Executor().run(feed=feed2, fetch_list=[sid, lens])
+    np.testing.assert_array_equal(rlen2, [1, 3])
+    # 2-level reconstruction: source 0 has hyps [[0]] and [7,8,6]
+    out = LoDTensor(rid2[:, :, None], rlen2, router)
+    nested = out.nested_rows()
+    np.testing.assert_array_equal(nested[0][0][:, 0], [0])
+    np.testing.assert_array_equal(nested[0][1][:, 0], [7, 8, 6])

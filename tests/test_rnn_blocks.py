@@ -433,3 +433,31 @@ def test_switch_nested_raises():
                     with inner.case(cond):
                         layers.assign(layers.fill_constant(
                             [1], 'float32', 1.0), out)
+
+
+def test_static_rnn_boot_memory_dynamic_batch():
+    """Reference programs built with default append_batch_size=True have
+    batch dim -1; StaticRNN.memory(shape=, batch_ref=) must boot via
+    fill_constant_batch_size_like (VERDICT r4 #6) — the batch is only
+    known at feed time, and different batch sizes run the same program."""
+    T, D, H = 3, 2, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        # time-major sequence with an UNKNOWN batch dim
+        x = fluid.layers.data('x', shape=[T, -1, D], dtype='float32',
+                              append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)       # [-1, D] step slice
+            h = rnn.memory(shape=[-1, D], batch_ref=xt,
+                           init_batch_dim_idx=0, ref_batch_dim_idx=0,
+                           init_value=0.0)
+            nh = layers.elementwise_add(h, xt)   # running sum
+            rnn.update_memory(h, nh)
+            rnn.output(nh)
+        seq = rnn()                      # [T, B, D]
+    exe = fluid.Executor()
+    for B in (2, 5):                     # same program, two batch sizes
+        xv = np.arange(T * B * D, dtype='float32').reshape(T, B, D)
+        got, = exe.run(main, feed={'x': xv}, fetch_list=[seq])
+        np.testing.assert_allclose(got, np.cumsum(xv, axis=0), rtol=1e-6)
