@@ -249,8 +249,30 @@ def main():
                             return_numpy=False)
         np.asarray(loss)  # block
         dt = time.perf_counter() - t0
+        tps_single = steps * tokens_per_step / dt
 
-    tps = steps * tokens_per_step / dt
+        # multi-step fused loop (the headline): K iterations per device
+        # launch via run_steps — one lax.scan executable amortizes the
+        # ~60 ms synchronous-dispatch cost per launch that PERF.md's
+        # round-5 ledger attributes to the device tunnel
+        K = max(2, int(os.environ.get('BENCH_STEPS_PER_LAUNCH', '8')))
+        import jax.numpy as jnp
+        superfeed = {k: jnp.stack([v] * K) for k, v in feed.items()}
+        t0 = time.perf_counter()
+        losses, = exe.run_steps(main_prog, feed_list=superfeed, steps=K,
+                                fetch_list=[out['loss']])
+        print('BENCH: %d-step fused compile+warmup ok (%.1fs)'
+              % (K, time.perf_counter() - t0), file=sys.stderr)
+        launches = max(1, steps // K)
+        t0 = time.perf_counter()
+        for _ in range(launches):
+            losses, = exe.run_steps(main_prog, feed_list=superfeed,
+                                    steps=K, fetch_list=[out['loss']],
+                                    return_numpy=False)
+        np.asarray(losses)  # block
+        dt = time.perf_counter() - t0
+
+    tps = launches * K * tokens_per_step / dt
 
     # model FLOPs (scaling-book accounting): 6*P per trained token for the
     # MATMUL params (embedding gathers excluded — they do no MXU work),
@@ -288,6 +310,8 @@ def main():
         'matmul_params_m': round(n_matmul_params / 1e6, 1),
         'backend': device_kind,
         'batch': B, 'seq': T, 'amp': True, 'flash': True,
+        'steps_per_launch': K,
+        'single_step_tokens_per_sec': round(tps_single, 1),
     }
     rec.update(resnet_rec)
     if fallback_reason:

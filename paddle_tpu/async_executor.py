@@ -6,13 +6,16 @@ paddle/fluid/framework/async_executor.cc (multi-threaded file-fed training).
 TPU-native redesign: the reference runs one CPU trainer thread per file, each
 stepping its own program copy; on TPU there is ONE jitted train step, so the
 parallelism that matters is host-side — the C++ BatchReader's reader/shuffle/
-batch threads overlap file IO with the device step, and the executor just
-drains the prefetch queue.
+batch threads overlap file IO with the host-side FeedPrefetcher, which
+stacks `steps_per_launch` batches into a superbatch and device_puts it while
+the device runs the current launch (Executor.run_steps: K iterations fused
+into one lax.scan executable = one dispatch through the device tunnel).
 """
 import numpy as np
 
 from .core.executor import Executor
 from .core.framework import default_main_program
+from .data_feeder import FeedPrefetcher
 from .native import BatchReader, DataFeedDesc
 
 __all__ = ['AsyncExecutor']
@@ -23,12 +26,15 @@ class AsyncExecutor(object):
         self._exe = Executor(place)
 
     def run(self, program, data_feed, filelist, thread_num=1,
-            fetch=None, mode='', debug=False, fetch_every_n_steps=1):
+            fetch=None, mode='', debug=False, fetch_every_n_steps=1,
+            steps_per_launch=1):
         """Run `program` once over every batch the data feed yields.
 
         data_feed: a native.DataFeedDesc (slot names map batch fields to
         feed vars) or a ready BatchReader whose field order matches
-        `feed_order` slots.  thread_num tunes the native prefetch depth.
+        `feed_order` slots.  thread_num tunes the native prefetch depth
+        AND the superbatch queue bound.  steps_per_launch=K fuses K
+        iterations into one device launch.
         Returns the list of fetch results from the last step.
         """
         program = program or default_main_program()
@@ -49,15 +55,28 @@ class AsyncExecutor(object):
             raise TypeError('data_feed must be DataFeedDesc or BatchReader')
 
         fetch = fetch or []
+        feeds = ({n: np.asarray(v) for n, v in zip(slot_names, fields)}
+                 for fields in reader)
+        prefetcher = FeedPrefetcher(feeds, steps=max(1, steps_per_launch),
+                                    capacity=max(2, int(thread_num)))
         last = None
-        for step, fields in enumerate(reader):
-            feed = {n: np.asarray(v) for n, v in zip(slot_names, fields)}
-            out = self._exe.run(program, feed=feed, fetch_list=fetch)
-            if fetch:
-                last = out
-                if debug and step % max(1, fetch_every_n_steps) == 0:
-                    print('step %d: %s' %
-                          (step, [np.asarray(o).ravel()[:4] for o in out]))
+        step = 0
+        try:
+            for superbatch, k in prefetcher:
+                out = self._exe.run_steps(program, feed_list=superbatch,
+                                          steps=k, fetch_list=fetch)
+                step += k
+                if fetch:
+                    # fetches come back stacked [k, ...]; the contract is
+                    # the LAST step's values
+                    last = [np.asarray(o[-1]) for o in out]
+                    if debug and (step - 1) % max(1, fetch_every_n_steps) \
+                            < k:
+                        print('step %d: %s' %
+                              (step - 1,
+                               [np.asarray(o).ravel()[:4] for o in last]))
+        finally:
+            prefetcher.close()
         return last
 
     def config_distributed_nodes(self, *a, **k):

@@ -4,7 +4,15 @@ The reference's BuildStrategy/ExecutionStrategy tune the SSA-graph executor
 (reduce strategy, num threads...).  Under whole-block XLA lowering most knobs
 are moot; `with_data_parallel` maps to a device-mesh data-parallel execution
 (parallel/parallel_executor.py).
+
+`ExecutionStrategy.num_iteration_per_drop_scope` keeps its reference role
+(amortize per-iteration executor overhead) but maps to the TPU-native
+mechanism: K > 1 routes a list-of-dicts feed through Executor.run_steps,
+fusing K iterations into ONE device launch (a jitted lax.scan) instead of
+merely deferring scope cleanup.
 """
+import numpy as np
+
 from .core.executor import _CompiledProgramBase
 
 __all__ = ['CompiledProgram', 'BuildStrategy', 'ExecutionStrategy']
@@ -40,9 +48,10 @@ class ExecutionStrategy(object):
 
 
 class CompiledProgram(_CompiledProgramBase):
-    def __init__(self, program, build_strategy=None):
+    def __init__(self, program, build_strategy=None, exec_strategy=None):
         self._program = program
         self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy
         self._data_parallel = False
         self._places = None
         self._loss_name = None
@@ -55,23 +64,71 @@ class CompiledProgram(_CompiledProgramBase):
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
         self._places = places
         return self
 
     def with_inference_optimize(self, config):
         return self
 
-    def _run(self, exe, feed, fetch_list, scope, return_numpy):
-        if not self._data_parallel:
-            return exe.run(self._program, feed=feed, fetch_list=fetch_list,
-                           scope=scope, return_numpy=return_numpy)
+    @property
+    def _steps_per_launch(self):
+        es = self._exec_strategy
+        return max(1, int(getattr(es, 'num_iteration_per_drop_scope', 1)
+                          if es is not None else 1))
+
+    def _pe_for(self, exe):
         if self._pe is None:
             from .parallel.parallel_executor import ParallelExecutor
             self._pe = ParallelExecutor(
                 use_cuda=False, loss_name=self._loss_name,
                 main_program=self._program,
                 build_strategy=self._build_strategy)
+        return self._pe
+
+    def _run(self, exe, feed, fetch_list, scope, return_numpy):
+        k = self._steps_per_launch
+        if k > 1 and isinstance(feed, (list, tuple)):
+            # num_iteration_per_drop_scope > 1 + a list of per-step feeds:
+            # run the whole list K iterations per device launch and return
+            # the per-step fetches stacked over ALL steps
+            return self._run_steps(exe, list(feed), fetch_list, None,
+                                   scope, return_numpy)
+        if not self._data_parallel:
+            return exe.run(self._program, feed=feed, fetch_list=fetch_list,
+                           scope=scope, return_numpy=return_numpy)
         fetch_names = [f if isinstance(f, str) else f.name
                        for f in (fetch_list or [])]
-        return self._pe.run(fetch_names, feed=feed,
-                            return_numpy=return_numpy)
+        return self._pe_for(exe).run(fetch_names, feed=feed,
+                                     return_numpy=return_numpy)
+
+    def _run_steps(self, exe, feed_list, fetch_list, steps, scope,
+                   return_numpy):
+        """K-iterations-per-launch execution: chunk the per-step feeds by
+        num_iteration_per_drop_scope and fuse each chunk into one launch."""
+        k = steps or self._steps_per_launch
+        if self._data_parallel:
+            runner = self._pe_for(exe)
+            run_kwargs = {}
+        else:
+            runner = exe
+            run_kwargs = {'scope': scope}
+        if isinstance(feed_list, dict):   # pre-stacked superbatch
+            return runner.run_steps(self._program, feed_list=feed_list,
+                                    fetch_list=fetch_list, steps=k,
+                                    return_numpy=return_numpy, **run_kwargs)
+        chunks = [feed_list[i:i + k] for i in range(0, len(feed_list), k)]
+        outs = [runner.run_steps(self._program, feed_list=c,
+                                 fetch_list=fetch_list, steps=len(c),
+                                 return_numpy=return_numpy, **run_kwargs)
+                for c in chunks]
+        if len(outs) == 1:
+            return outs[0]
+        cat = np.concatenate if return_numpy else _jnp_concat
+        return [cat([o[i] for o in outs]) for i in range(len(outs[0]))]
+
+
+def _jnp_concat(arrs):
+    import jax.numpy as jnp
+    return jnp.concatenate(arrs)

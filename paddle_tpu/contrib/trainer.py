@@ -103,7 +103,62 @@ class Trainer(object):
         return DataFeeder(feed_vars, program=program)
 
     def train(self, num_epochs, event_handler, reader=None,
-              feed_order=None):
+              feed_order=None, steps_per_launch=1):
+        """steps_per_launch=K fuses K train iterations into ONE device
+        launch (Executor.run_steps — a jitted lax.scan), amortizing the
+        per-launch dispatch cost.  Step events still fire per iteration
+        with that iteration's metrics (sliced from the stacked fetches);
+        BeginStepEvent.fetch_metrics is honored at launch granularity
+        (the first step's choice governs its whole launch)."""
+        if steps_per_launch <= 1:
+            return self._train_single(num_epochs, event_handler, reader,
+                                      feed_order)
+        feeder = self._feeder(feed_order, self.train_program)
+        K = int(steps_per_launch)
+        with scope_guard(self.scope):
+            for epoch_id in range(self._resume_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                buf = []
+                step_id = 0
+                stopped = False
+
+                def flush(buf, step_id):
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    for i in range(1, len(buf)):
+                        event_handler(BeginStepEvent(epoch_id, step_id + i))
+                    fetch = [m.name for m in self.metrics] \
+                        if begin.fetch_metrics else []
+                    stacked = self.exe.run_steps(self.train_program,
+                                                 feed_list=buf,
+                                                 fetch_list=fetch,
+                                                 steps=len(buf))
+                    for i in range(len(buf)):
+                        metrics = [np.asarray(m[i]) for m in stacked]
+                        if self.checkpointer:
+                            self.checkpointer.maybe_save(epoch_id,
+                                                         step_id + i)
+                        event_handler(EndStepEvent(epoch_id, step_id + i,
+                                                   metrics))
+                    return step_id + len(buf)
+
+                for data in reader():
+                    if self.__stop:
+                        stopped = True
+                        break
+                    buf.append(feeder.feed(data))
+                    if len(buf) == K:
+                        step_id = flush(buf, step_id)
+                        buf = []
+                if buf and not stopped:
+                    step_id = flush(buf, step_id)
+                if stopped:
+                    if self.checkpointer:
+                        self.checkpointer.save(epoch_id, step_id)
+                    return
+                event_handler(EndEpochEvent(epoch_id))
+
+    def _train_single(self, num_epochs, event_handler, reader, feed_order):
         feeder = self._feeder(feed_order, self.train_program)
         with scope_guard(self.scope):
             for epoch_id in range(self._resume_epoch, num_epochs):

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .dtypes import jax_dtype
+
 # ops this module executes natively (no registry impl, no shape inference)
 NATIVE_OPS = {'while', 'conditional_block', 'write_to_array',
               'read_from_array', 'array_length', 'recurrent'}
@@ -185,7 +187,7 @@ def exec_control_flow_op(op, env, ectx, op_index, program):
         _exec_array_read(op, env)
     elif op.type == 'array_length':
         arr = _get_array(env, op.inputs['A'][0])
-        env[op.outputs['Out'][0]] = arr.length.reshape((1,)).astype(jnp.int64)
+        env[op.outputs['Out'][0]] = arr.length.reshape((1,)).astype(jax_dtype('int64'))
     else:
         raise KeyError('unknown native control-flow op %s' % op.type)
 

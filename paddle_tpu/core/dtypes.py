@@ -36,6 +36,26 @@ def convert_dtype(dtype):
     return np.dtype(dtype)
 
 
+_X64_NARROW = {'int64': 'int32', 'uint64': 'uint32',
+               'float64': 'float32', 'complex128': 'complex64'}
+
+
+def jax_dtype(dtype):
+    """convert_dtype for values materialized INSIDE a jax computation.
+
+    With x64 disabled (the default), asking jnp.full/astype for a 64-bit
+    dtype emits a warn-and-truncate per trace; the truncation is the
+    semantics we run with either way, so map 64->32 bit explicitly here
+    and keep the traces silent.  Host-side numpy arrays (feeds, readers)
+    keep full convert_dtype widths."""
+    d = convert_dtype(dtype)
+    if d.name in _X64_NARROW:
+        import jax
+        if not jax.config.jax_enable_x64:
+            return np.dtype(_X64_NARROW[d.name])
+    return d
+
+
 def dtype_str(dtype):
     d = convert_dtype(dtype)
     name = d.name

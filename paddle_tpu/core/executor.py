@@ -98,6 +98,21 @@ def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
+def _stack_feeds(per_step):
+    """Stack K per-step feed dicts on a new leading [K] axis.  Host arrays
+    stack on host (one device_put per superbatch, not per step); if any
+    step's value is already a device array the stack happens on device."""
+    stacked = {}
+    for k in per_step[0]:
+        vals = [f[k] for f in per_step]
+        if any(hasattr(v, 'devices') for v in vals):
+            import jax.numpy as jnp
+            stacked[k] = jnp.stack(vals)
+        else:
+            stacked[k] = np.stack(vals)
+    return stacked
+
+
 def _zero_cotangent(v):
     import jax
     import jax.numpy as jnp
@@ -298,13 +313,27 @@ def _analyze(block, feed_names, fetch_names):
     return required, written
 
 
+# traces completed by _lower-built functions — a python-side effect that
+# runs once per jit trace, so tests can assert "retraced exactly once per
+# cache key" directly instead of inferring it from cache sizes
+_TRACE_COUNT = [0]
+
+
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
-           out_shardings_for=None, check_nan=False):
+           out_shardings_for=None, check_nan=False, steps=None):
     """Build the jitted step function for (program, feeds, fetches).
     check_nan compiles a fused all-finite flag over fetches+updates INTO
     the executable (per-array host checks measured >30x slower through
     the device tunnel — see PERF.md); run_fn then returns a third
-    output, one bool scalar."""
+    output, one bool scalar.
+
+    steps=None lowers the classic one-step executable.  steps=K lowers K
+    training iterations into ONE executable: a lax.scan over feeds
+    stacked on a leading [K] axis, parameter/optimizer state threaded as
+    the (donated) carry, per-step RNG derived by folding `counter + i`
+    into the program seed (bitwise-identical to K sequential runs, which
+    consume counters counter..counter+K-1), fetches stacked per step,
+    and the check_nan flag AND-reduced across the scan."""
     import jax
     import jax.numpy as jnp
 
@@ -321,8 +350,15 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
     bw_idx = next((i for i, op in enumerate(ops)
                    if op.type == _BACKWARD_OP), None)
 
-    def run_fn(params, feeds, seed):
-        base_key = jax.random.key(seed)
+    def step_fn(params, feeds, counter):
+        _TRACE_COUNT[0] += 1
+        # the run counter is FOLDED into the program key rather than mixed
+        # arithmetically into the seed: inside a K-step scan the per-step
+        # key is fold_in(key, counter + i), which is exactly what the i-th
+        # sequential run would derive — multi-step and single-step paths
+        # share one RNG stream by construction
+        base_key = jax.random.fold_in(
+            jax.random.key(program.random_seed), counter)
         ectx = registry.ExecCtx(base_key, mesh=mesh)
         env0 = {}
         env0.update(feeds)
@@ -401,6 +437,43 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                 ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v)))
         return fetches, updates, ok
 
+    if steps is None:
+        run_fn = step_fn
+    else:
+        def run_fn(params, feeds, counter):
+            # feeds arrive stacked [steps, ...]; params thread as carry.
+            # The carry only needs `required` names: a persistable that is
+            # write-only within one step is overwritten before any read,
+            # so its start-of-step value never matters — its LAST value is
+            # recovered from the stacked per-step outputs below.
+            import jax.lax as lax
+            step_ids = jnp.arange(steps, dtype=jnp.uint32)
+
+            def body(carry, xs):
+                feeds_i, i = xs
+                if check_nan:
+                    p, ok_all = carry
+                else:
+                    p = carry
+                res = step_fn(p, feeds_i, counter + i)
+                fetches_i, updates_i = res[0], res[1]
+                new_p = {n: updates_i[n] for n in p}
+                extra_i = {n: v for n, v in updates_i.items() if n not in p}
+                if check_nan:
+                    return ((new_p, jnp.logical_and(ok_all, res[2])),
+                            (fetches_i, extra_i))
+                return new_p, (fetches_i, extra_i)
+
+            init = (params, jnp.asarray(True)) if check_nan else params
+            carry_out, (fetches, extras) = lax.scan(
+                body, init, (feeds, step_ids))
+            final_p = carry_out[0] if check_nan else carry_out
+            updates = dict(final_p)
+            updates.update({n: v[-1] for n, v in extras.items()})
+            if check_nan:
+                return fetches, updates, carry_out[1]
+            return fetches, updates
+
     jit_kwargs = {}
     if donate and writeback:
         jit_kwargs['donate_argnums'] = (0,)
@@ -412,9 +485,20 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
             return NamedSharding(mesh, spec.get(name, default))
         # feeds default to batch-sharding over the 'data' axis if present
         feed_default = P('data') if 'data' in mesh.axis_names else P()
+        if steps is None:
+            feed_shardings = {n: shard_of(n, feed_default)
+                              for n in feed_names}
+        else:
+            # stacked feeds put the step axis first: prepend an
+            # unsharded dim so the in-scan batch sharding matches the
+            # single-step mesh path exactly
+            def stacked_shard(name):
+                s = spec.get(name, feed_default)
+                return NamedSharding(mesh, P(*((None,) + tuple(s))))
+            feed_shardings = {n: stacked_shard(n) for n in feed_names}
         jit_kwargs['in_shardings'] = (
             {n: shard_of(n) for n in params_in},
-            {n: shard_of(n, feed_default) for n in feed_names},
+            feed_shardings,
             NamedSharding(mesh, P()),
         )
     return jax.jit(run_fn, **jit_kwargs), params_in, writeback
@@ -456,20 +540,12 @@ class Executor(object):
                 raise TypeError('bad fetch entry: %r' % (f,))
         return names
 
-    def run(self, program=None, feed=None, fetch_list=None,
-            feed_var_name='feed', fetch_var_name='fetch', scope=None,
-            return_numpy=True, use_program_cache=True):
-        import jax
-
-        if program is None:
-            program = default_main_program()
-        if isinstance(program, _CompiledProgramBase):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
-        scope = scope if scope is not None else global_scope()
-        feed = feed or {}
-        block = program.global_block()
+    def _normalize_feed(self, block, feed):
+        """One per-step feed dict -> {name: array}, with LoDTensor feeds
+        expanded to padded+lengths and lod lengths synthesized for dense
+        arrays fed into lod vars."""
         feed_vals = {}
-        for k, v in feed.items():
+        for k, v in (feed or {}).items():
             if not block.has_var(k):
                 raise KeyError(
                     'feed var "%s" is not a variable of this program; '
@@ -497,18 +573,91 @@ class Executor(object):
                 arr = feed_vals[k]
                 feed_vals[lname] = np.full((arr.shape[0],), arr.shape[1],
                                            dtype=np.int32)
+        return feed_vals
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name='feed', fetch_var_name='fetch', scope=None,
+            return_numpy=True, use_program_cache=True):
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, _CompiledProgramBase):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+        scope = scope if scope is not None else global_scope()
+        feed_vals = self._normalize_feed(program.global_block(), feed)
+        return self._run_impl(program, feed_vals, fetch_list, scope,
+                              return_numpy, use_program_cache, steps=None)
+
+    def run_steps(self, program=None, feed_list=None, fetch_list=None,
+                  steps=None, scope=None, return_numpy=True,
+                  use_program_cache=True):
+        """Run `steps` training iterations in ONE device launch.
+
+        The K iterations lower to a single jitted lax.scan (see _lower):
+        one dispatch through the device tunnel instead of K, donated
+        state threaded through the scan carry, per-step RNG folded from
+        the shared run counter — bitwise-identical on CPU to K
+        sequential `run` calls with the same feeds.
+
+        feed_list: a list of K per-step feed dicts, or ONE dict whose
+        arrays are already stacked on a leading [K] axis (pass `steps`
+        explicitly in that case — e.g. a superbatch from
+        data_feeder.FeedPrefetcher).
+        Returns the fetches stacked per step: each entry is [K, ...].
+        """
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, _CompiledProgramBase):
+            return program._run_steps(self, feed_list, fetch_list, steps,
+                                      scope, return_numpy)
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        if isinstance(feed_list, dict):
+            if steps is None:
+                raise ValueError(
+                    'run_steps with a pre-stacked feed dict needs steps=K')
+            feed_vals = {k: (v if hasattr(v, 'devices') else np.asarray(v))
+                         for k, v in feed_list.items()}
+            for k, v in feed_vals.items():
+                if v.shape[0] != steps:
+                    raise ValueError(
+                        'stacked feed "%s" has leading dim %d, expected '
+                        'steps=%d' % (k, v.shape[0], steps))
+        else:
+            per_step = [self._normalize_feed(block, f)
+                        for f in (feed_list or [])]
+            if not per_step:
+                raise ValueError('run_steps needs a non-empty feed_list')
+            if steps is None:
+                steps = len(per_step)
+            elif steps != len(per_step):
+                raise ValueError('steps=%d but feed_list has %d entries'
+                                 % (steps, len(per_step)))
+            names = set(per_step[0])
+            for f in per_step[1:]:
+                if set(f) != names:
+                    raise ValueError('per-step feeds disagree on keys: '
+                                     '%s vs %s' % (sorted(names), sorted(f)))
+            feed_vals = _stack_feeds(per_step)
+        return self._run_impl(program, feed_vals, fetch_list, scope,
+                              return_numpy, use_program_cache,
+                              steps=int(steps))
+
+    def _run_impl(self, program, feed_vals, fetch_list, scope,
+                  return_numpy, use_program_cache, steps):
+        import jax
         feed_names = tuple(sorted(feed_vals.keys()))
         fetch_names = tuple(self._resolve_fetch(fetch_list))
 
-        key = (id(program), program._version, feed_names, fetch_names,
-               scope._serial, self.check_nan)
+        base_key = (id(program), program._version, feed_names, fetch_names,
+                    scope._serial)
+        key = base_key + (self.check_nan, steps)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             # the cached tuple keeps a strong ref to `program` so its id()
             # (part of the key) can never be recycled by a new Program
             entry = _lower(program, feed_names, fetch_names,
                            donate=True, mesh=self.mesh,
-                           check_nan=self.check_nan) + (program,)
+                           check_nan=self.check_nan, steps=steps) + (program,)
             if use_program_cache:
                 self._cache[key] = entry
         fn, params_in, writeback = entry[:3]
@@ -526,28 +675,27 @@ class Executor(object):
             # program's annotated layout.  Target shardings are cached per
             # lowering entry, and device_put is skipped once the written-
             # back arrays already carry the right sharding (steady state).
-            targets = self._shard_targets.get(key[:-1])
+            targets = self._shard_targets.get(base_key)
             if targets is None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 spec = program._sharding
                 targets = {n: NamedSharding(self.mesh, spec.get(n, P()))
                            for n in params_in}
-                self._shard_targets[key[:-1]] = targets
+                self._shard_targets[base_key] = targets
             params = {n: (v if getattr(v, 'sharding', None) == targets[n]
                           else jax.device_put(v, targets[n]))
                       for n, v in params.items()}
 
-        # the rng stream is keyed WITHOUT check_nan so toggling the debug
-        # flag mid-training does not restart dropout masks
-        ctr_key = key[:-1]
-        counter = self._run_counter.get(ctr_key, 0)
-        self._run_counter[ctr_key] = counter + 1
-        seed = np.uint32((program.random_seed * 1000003 + counter)
-                         & 0xffffffff)
+        # the rng stream is keyed WITHOUT check_nan or steps: toggling the
+        # debug flag mid-training does not restart dropout masks, and a
+        # K-step launch consumes the same K counters that K sequential
+        # runs would — mixed run/run_steps usage shares one stream
+        counter = self._run_counter.get(base_key, 0)
+        self._run_counter[base_key] = counter + (steps or 1)
 
         result = fn(params,
                     {n: feed_vals[n] for n in feed_names},
-                    seed)
+                    np.uint32(counter & 0xffffffff))
         fetches, updates = result[0], result[1]
         # write back BEFORE the nan check: params were donated, so the old
         # scope arrays are dead — raising first would leave the scope
@@ -556,7 +704,9 @@ class Executor(object):
             scope.vars[n] = v
         if self.check_nan and not bool(result[2]):
             # fused in-executable flag tripped: per-array pass to NAME
-            # the culprits (slow, but only runs on actual failure)
+            # the culprits (slow, but only runs on actual failure).  For a
+            # K-step launch the fetches are stacked [K, ...] and the
+            # updates are end-of-scan state — both still name the vars.
             self._assert_finite(itertools.chain(
                 zip(fetch_names, fetches), updates.items()))
         if return_numpy:
@@ -597,4 +747,8 @@ class _CompiledProgramBase(object):
     (see compiler.py / parallel/parallel_executor.py)."""
 
     def _run(self, exe, feed, fetch_list, scope, return_numpy):
+        raise NotImplementedError
+
+    def _run_steps(self, exe, feed_list, fetch_list, steps, scope,
+                   return_numpy):
         raise NotImplementedError

@@ -103,5 +103,9 @@ class OpCtx(object):
         return self._exec.mesh
 
     def rng(self, n=0):
+        # op streams are 1-based: stream 0 off the run key is reserved for
+        # the executor itself (the run key is already one fold deep — the
+        # run counter is folded into the program key — so op draws must
+        # never collide with a bare counter fold)
         return jax.random.fold_in(self._exec.base_key,
-                                  self.op_index * 1009 + n)
+                                  (self.op_index + 1) * 1009 + n)

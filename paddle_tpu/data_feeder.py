@@ -2,14 +2,126 @@
 
 Parity: reference python/paddle/fluid/data_feeder.py.  Ragged (lod_level>0)
 slots become LoDTensors (padded + lengths, core/lod.py).
+
+FeedPrefetcher is the host side of the multi-step execution path
+(Executor.run_steps): a bounded background queue that stacks K per-step
+feed dicts into one [K, ...] superbatch and device_puts it while the
+device runs the current launch, so host->device transfer overlaps compute.
 """
+import queue
+import threading
+
 import numpy as np
 
 from .core.framework import Variable, default_main_program
 from .core.lod import create_lod_tensor
 from .core.dtypes import convert_dtype
 
-__all__ = ['DataFeeder']
+__all__ = ['DataFeeder', 'FeedPrefetcher']
+
+
+class FeedPrefetcher(object):
+    """Bounded background prefetch queue over an iterable of feed dicts.
+
+    Pulls per-step feed dicts from `feeds`, stacks every `steps` of them
+    on a new leading axis (np.stack on host — ONE device_put per
+    superbatch instead of one per step), optionally uploads the stack,
+    and parks the result in a bounded queue.  A single worker thread
+    preserves order; reader exhaustion flushes the partial tail (its true
+    length is yielded alongside) and drains cleanly; a reader exception
+    is re-raised in the consumer at the point it would have been read.
+
+    Iterating yields (stacked_feed_dict, k) with k == steps except for
+    the final partial superbatch.  Feed Executor.run_steps directly:
+
+        for superbatch, k in FeedPrefetcher(batches, steps=8):
+            losses = exe.run_steps(prog, feed_list=superbatch, steps=k,
+                                   fetch_list=[loss])
+    """
+
+    def __init__(self, feeds, steps=1, capacity=2, to_device=True):
+        if steps < 1:
+            raise ValueError('steps must be >= 1, got %r' % (steps,))
+        if capacity < 1:
+            raise ValueError('capacity must be >= 1, got %r' % (capacity,))
+        self._src = iter(feeds)
+        self._steps = int(steps)
+        self._to_device = to_device
+        self._q = queue.Queue(maxsize=int(capacity))
+        self._terminal = None   # ('done',) | ('error', exc) | ('closed',)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._worker, name='FeedPrefetcher', daemon=True)
+        self._thread.start()
+
+    def _pack(self, buf):
+        names = set(buf[0])
+        for f in buf[1:]:
+            if set(f) != names:
+                raise ValueError('per-step feeds disagree on keys: %s vs %s'
+                                 % (sorted(names), sorted(f)))
+        stacked = {k: np.stack([np.asarray(f[k]) for f in buf])
+                   for k in buf[0]}
+        if self._to_device:
+            import jax
+            stacked = jax.device_put(stacked)
+        return stacked, len(buf)
+
+    def _put(self, item):
+        # bounded put that stays responsive to close(): never blocks
+        # forever on a consumer that went away
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            buf = []
+            for f in self._src:
+                if self._stop.is_set():
+                    return
+                buf.append(f)
+                if len(buf) == self._steps:
+                    if not self._put(('batch', self._pack(buf))):
+                        return
+                    buf = []
+            if buf:
+                if not self._put(('batch', self._pack(buf))):
+                    return
+            self._put(('done', None))
+        except BaseException as e:  # noqa: BLE001 - relayed to consumer
+            self._put(('error', e))
+
+    def __iter__(self):
+        while True:
+            if self._terminal is not None:
+                # exhausted/errored/closed: iterating again yields nothing
+                # instead of blocking on a queue no worker will ever fill
+                return
+            kind, payload = self._q.get()
+            if kind == 'done':
+                self._terminal = ('done',)
+                return
+            if kind == 'error':
+                self._terminal = ('error', payload)
+                raise payload
+            yield payload
+
+    def close(self):
+        """Stop the worker and release the queue (safe to call twice)."""
+        if self._terminal is None:
+            self._terminal = ('closed',)
+        self._stop.set()
+        while True:  # unblock a worker parked on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)
 
 
 class DataFeeder(object):

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register
+from ..core.dtypes import jax_dtype
 from .sequence import _length_or_full, _lstm_scan, _ACTS
 
 _NEG = -1e30  # log-space "minus infinity" that survives bf16/f32 adds
@@ -138,7 +139,7 @@ def ctc_align(ctx, ins, attrs):
 
     out = jax.vmap(compact)(tok, keep)
     out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
-    return {'Output': out.astype(jnp.int64), 'OutLength': out_len}
+    return {'Output': out.astype(jax_dtype('int64')), 'OutLength': out_len}
 
 
 # ------------------------------------------------------------------ CRF
@@ -241,8 +242,8 @@ def crf_decoding(ctx, ins, attrs):
         lab = _squeeze_label(ins['Label']).astype(path.dtype)
         valid = tpos[None, :] < length[:, None]
         return {'ViterbiPath':
-                (jnp.where(valid, path == lab, False)).astype(jnp.int64)}
-    return {'ViterbiPath': path.astype(jnp.int64)}
+                (jnp.where(valid, path == lab, False)).astype(jax_dtype('int64'))}
+    return {'ViterbiPath': path.astype(jax_dtype('int64'))}
 
 
 # ---------------------------------------------------------------- lstmp

@@ -242,8 +242,8 @@ def clip_by_norm(ctx, ins, attrs):
 
 @register('cast')
 def cast(ctx, ins, attrs):
-    from ..core.dtypes import convert_dtype
-    return {'Out': ins['X'].astype(convert_dtype(attrs['out_dtype']))}
+    from ..core.dtypes import jax_dtype
+    return {'Out': ins['X'].astype(jax_dtype(attrs['out_dtype']))}
 
 
 @register('cumsum')

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
-from ..core.dtypes import convert_dtype
+from ..core.dtypes import convert_dtype, jax_dtype
 
 
 def _key(ctx, attrs):
@@ -18,7 +18,7 @@ def _key(ctx, attrs):
 
 @register('uniform_random')
 def uniform_random(ctx, ins, attrs):
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
     shape = [int(d) for d in attrs['shape']]
     out = jax.random.uniform(_key(ctx, attrs), shape,
                              minval=attrs.get('min', -1.0),
@@ -28,7 +28,7 @@ def uniform_random(ctx, ins, attrs):
 
 @register('gaussian_random')
 def gaussian_random(ctx, ins, attrs):
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
     shape = [int(d) for d in attrs['shape']]
     out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
         jax.random.normal(_key(ctx, attrs), shape)
@@ -37,7 +37,7 @@ def gaussian_random(ctx, ins, attrs):
 
 @register('truncated_gaussian_random')
 def truncated_gaussian_random(ctx, ins, attrs):
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
     shape = [int(d) for d in attrs['shape']]
     out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
         jax.random.truncated_normal(_key(ctx, attrs), -2.0, 2.0, shape)
@@ -49,7 +49,7 @@ def sampling_id(ctx, ins, attrs):
     x = ins['X']  # [B, C] probabilities
     key = _key(ctx, attrs)
     ids = jax.random.categorical(key, jnp.log(x + 1e-20), axis=-1)
-    return {'Out': ids.astype(jnp.int64)}
+    return {'Out': ids.astype(jax_dtype('int64'))}
 
 
 @register('random_crop')

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register
+from ..core.dtypes import jax_dtype
 
 
 def _mask(x, length):
@@ -128,7 +129,7 @@ def sequence_pad(ctx, ins, attrs):
     x = ins['X']
     length = _length_or_full(ins, x)
     # already padded in our representation
-    return {'Out': x, 'Length': length.astype(jnp.int64)}
+    return {'Out': x, 'Length': length.astype(jax_dtype('int64'))}
 
 
 @register('sequence_unpad')

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register
-from ..core.dtypes import convert_dtype
+from ..core.dtypes import convert_dtype, jax_dtype
 
 
 @register('reshape')
@@ -214,7 +214,7 @@ def lookup_table(ctx, ins, attrs):
 
 @register('fill_constant')
 def fill_constant(ctx, ins, attrs):
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
     shape = [int(d) for d in attrs['shape']]
     return {'Out': jnp.full(shape, attrs['value'], dtype=dtype)}
 
@@ -226,7 +226,7 @@ def fill_constant_batch_size_like(ctx, ins, attrs):
     in_idx = attrs.get('input_dim_idx', 0)
     out_idx = attrs.get('output_dim_idx', 0)
     shape[out_idx] = ref.shape[in_idx]
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
     return {'Out': jnp.full(shape, attrs['value'], dtype=dtype)}
 
 
@@ -257,19 +257,19 @@ def top_k(ctx, ins, attrs):
     x = ins['X']
     k = attrs['k']
     vals, idx = lax.top_k(x, k)
-    return {'Out': vals, 'Indices': idx.astype(jnp.int64)}
+    return {'Out': vals, 'Indices': idx.astype(jax_dtype('int64'))}
 
 
 @register('arg_max')
 def arg_max(ctx, ins, attrs):
     return {'Out': jnp.argmax(ins['X'], axis=attrs.get('axis', -1))
-            .astype(jnp.int64)}
+            .astype(jax_dtype('int64'))}
 
 
 @register('arg_min')
 def arg_min(ctx, ins, attrs):
     return {'Out': jnp.argmin(ins['X'], axis=attrs.get('axis', -1))
-            .astype(jnp.int64)}
+            .astype(jax_dtype('int64'))}
 
 
 @register('argsort')
@@ -277,7 +277,7 @@ def argsort(ctx, ins, attrs):
     x = ins['X']
     axis = attrs.get('axis', -1)
     idx = jnp.argsort(x, axis=axis)
-    return {'Out': jnp.sort(x, axis=axis), 'Indices': idx.astype(jnp.int64)}
+    return {'Out': jnp.sort(x, axis=axis), 'Indices': idx.astype(jax_dtype('int64'))}
 
 
 @register('reverse')
@@ -355,9 +355,9 @@ def where_index(ctx, ins, attrs):
         dim = cond.shape[d] if cond.ndim else 1
         coords.append(rem % dim)
         rem = rem // dim
-    out = jnp.stack(coords[::-1], axis=1).astype(jnp.int64)
+    out = jnp.stack(coords[::-1], axis=1).astype(jax_dtype('int64'))
     out = jnp.where(valid[:, None], out, -1)
-    return {'Out': out, 'Count': flat.sum().reshape(1).astype(jnp.int64)}
+    return {'Out': out, 'Count': flat.sum().reshape(1).astype(jax_dtype('int64'))}
 
 
 @register('py_func')
@@ -449,12 +449,13 @@ def py_func_op(ctx, ins, attrs):
 
 @register('hash')
 def hash_op(ctx, ins, attrs):
-    x = ins['X'].astype(jnp.int64)
+    x = ins['X'].astype(jax_dtype('int64'))
     num_hash = attrs.get('num_hash', 1)
     mod_by = attrs.get('mod_by', 100000007)
     outs = []
     for i in range(num_hash):
-        h = jnp.sum(x * jnp.asarray(1000003 ** (i + 1), jnp.int64), axis=-1,
+        h = jnp.sum(x * jnp.asarray(1000003 ** (i + 1) &
+                    0x7fffffff, jax_dtype('int64')), axis=-1,
                     keepdims=True)
         outs.append(jnp.abs(h) % mod_by)
     return {'Out': jnp.concatenate(outs, axis=-1)}

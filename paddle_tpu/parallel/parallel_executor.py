@@ -39,3 +39,16 @@ class ParallelExecutor(object):
         return self._exe.run(self._main_program, feed=feed,
                              fetch_list=list(fetch_list),
                              scope=self._scope, return_numpy=return_numpy)
+
+    def run_steps(self, program=None, feed_list=None, fetch_list=None,
+                  steps=None, return_numpy=True, **kwargs):
+        """K iterations per launch over the device mesh: the same jitted
+        lax.scan as the single-chip path, with the stacked feeds sharded
+        [None, 'data', ...] so the in-scan batch sharding matches the
+        single-step mesh layout exactly (see core/executor._lower)."""
+        kwargs.pop('scope', None)   # the PE owns its scope
+        return self._exe.run_steps(program or self._main_program,
+                                   feed_list=feed_list,
+                                   fetch_list=list(fetch_list or []),
+                                   steps=steps, scope=self._scope,
+                                   return_numpy=return_numpy, **kwargs)

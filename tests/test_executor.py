@@ -193,4 +193,4 @@ def test_clone_for_test_freezes_dropout_and_bn():
         # SHIFTED batch changes the output mean (batch-stat BN would
         # renormalize it away)
         c, = exe.run(test_prog, feed={'x': xv + 5.0}, fetch_list=[loss])
-        assert abs(float(np.asarray(c)) - float(np.asarray(a))) > 1.0
+        assert abs(np.asarray(c).ravel()[0] - np.asarray(a).ravel()[0]) > 1.0

@@ -156,4 +156,4 @@ def test_data_feeder_dense_and_lod_slots():
         exe.run(startup)
         t, p = exe.run(main, feed=feed, fetch_list=[total, pooled])
     np.testing.assert_allclose(np.asarray(p).ravel(), [6.0, 4.0])
-    np.testing.assert_allclose(float(np.asarray(t)), 16.0 + 10.0)
+    np.testing.assert_allclose(np.asarray(t).ravel()[0], 16.0 + 10.0)

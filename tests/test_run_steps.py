@@ -1,0 +1,330 @@
+"""Multi-step fused execution (Executor.run_steps) + async feed pipeline.
+
+The contract under test: K iterations fused into ONE lax.scan launch are
+bitwise-identical on CPU to K sequential exe.run calls — including the
+per-step RNG folding (dropout masks) and the check_nan fused flag — and
+the lowering cache retraces exactly once per (program, feeds, fetches, K).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import executor as executor_mod
+
+
+def _train_model(seed=7, dropout=0.5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, 16, act='relu')
+            if dropout:
+                h = fluid.layers.dropout(h, dropout_prob=dropout)
+            logits = fluid.layers.fc(h, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(K, batch=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'x': rng.randn(batch, 8).astype('float32'),
+             'lbl': rng.randint(0, 4, (batch, 1)).astype('int64')}
+            for _ in range(K)]
+
+
+def _run_sequential(main, startup, loss, feeds, check_nan=False):
+    exe, scope = fluid.Executor(check_nan=check_nan), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [exe.run(main, feed=f, fetch_list=[loss])[0]
+                  for f in feeds]
+    return np.concatenate([np.asarray(v).reshape(1, -1) for v in losses]), \
+        scope
+
+
+def test_run_steps_matches_sequential_bitwise():
+    K = 4
+    main, startup, loss = _train_model()
+    feeds = _feeds(K)
+    seq_losses, seq_scope = _run_sequential(main, startup, loss, feeds)
+
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        stacked, = exe.run_steps(main, feed_list=feeds, fetch_list=[loss])
+    assert stacked.shape[0] == K
+    # fetches stacked per step, bitwise equal to the sequential fetches
+    assert stacked.reshape(K, -1).tobytes() == seq_losses.tobytes()
+    # params + optimizer state (Adam moments, beta powers) bitwise equal
+    assert set(scope.vars) == set(seq_scope.vars)
+    for n in scope.vars:
+        a, b = np.asarray(seq_scope.vars[n]), np.asarray(scope.vars[n])
+        assert a.tobytes() == b.tobytes(), 'mismatch in %s' % n
+
+
+def test_run_steps_rng_folds_per_step():
+    # all-ones feeds: with dropout, per-step losses must DIFFER (distinct
+    # masks per scan step), and match the sequential RNG stream bitwise
+    K = 3
+    main, startup, loss = _train_model(dropout=0.5)
+    f = {'x': np.ones((16, 8), 'float32'),
+         'lbl': np.zeros((16, 1), 'int64')}
+    feeds = [f] * K
+    seq_losses, _ = _run_sequential(main, startup, loss, feeds)
+
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        stacked, = exe.run_steps(main, feed_list=feeds, fetch_list=[loss])
+    assert stacked.reshape(K, -1).tobytes() == seq_losses.tobytes()
+    assert len({v.tobytes() for v in stacked}) == K, \
+        'per-step dropout masks must differ inside one launch'
+
+
+def test_run_steps_prestacked_dict_and_step_count_validation():
+    K = 3
+    main, startup, loss = _train_model(dropout=0.0)
+    feeds = _feeds(K)
+    stacked_feed = {k: np.stack([f[k] for f in feeds]) for k in feeds[0]}
+
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match='steps'):
+            exe.run_steps(main, feed_list=stacked_feed, fetch_list=[loss])
+        with pytest.raises(ValueError, match='leading dim'):
+            exe.run_steps(main, feed_list=stacked_feed, fetch_list=[loss],
+                          steps=K + 1)
+        out, = exe.run_steps(main, feed_list=stacked_feed,
+                             fetch_list=[loss], steps=K)
+    assert out.shape[0] == K
+
+
+def test_run_steps_retraces_once_per_cache_key():
+    main, startup, loss = _train_model(dropout=0.0)
+    feeds = _feeds(6)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        before = executor_mod._TRACE_COUNT[0]
+        exe.run_steps(main, feed_list=feeds[:3], fetch_list=[loss])
+        after_first = executor_mod._TRACE_COUNT[0]
+        # one scan body trace for the whole 3-step executable
+        assert after_first == before + 1
+        exe.run_steps(main, feed_list=feeds[3:], fetch_list=[loss])
+        assert executor_mod._TRACE_COUNT[0] == after_first, \
+            'same (program, feeds, fetches, K) must reuse the executable'
+        # a different K is a different executable
+        exe.run_steps(main, feed_list=feeds[:2], fetch_list=[loss])
+        assert executor_mod._TRACE_COUNT[0] == after_first + 1
+
+
+def test_run_steps_check_nan_parity_and_raise():
+    K = 3
+    main, startup, loss = _train_model(dropout=0.3)
+    feeds = _feeds(K)
+    seq_losses, _ = _run_sequential(main, startup, loss, feeds,
+                                    check_nan=True)
+    exe, scope = fluid.Executor(check_nan=True), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        stacked, = exe.run_steps(main, feed_list=feeds, fetch_list=[loss])
+        assert stacked.reshape(K, -1).tobytes() == seq_losses.tobytes()
+
+    # a nan poisoning ANY step of the launch trips the scan-reduced flag
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        w = fluid.layers.create_parameter([2, 1], 'float32', name='w_ms')
+        loss2 = fluid.layers.reduce_mean(
+            fluid.layers.sqrt(fluid.layers.matmul(x, w)))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss2)
+    exe2, scope2 = fluid.Executor(check_nan=True), fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe2.run(startup2)
+        good = {'x': np.array([[1.0, 1.0]], 'float32')}
+        bad = {'x': np.array([[-100.0, -100.0]], 'float32')}
+        with pytest.raises(RuntimeError, match='w_ms'):
+            exe2.run_steps(main2, feed_list=[good, bad, good],
+                           fetch_list=[loss2])
+
+
+def test_run_steps_counter_shared_with_single_runs():
+    # run(1) + run_steps(2) consumes the same RNG stream as run(3): the
+    # counter advances by K per launch, so mixing paths stays coherent
+    K = 3
+    main, startup, loss = _train_model(dropout=0.5)
+    feeds = _feeds(K)
+    seq_losses, _ = _run_sequential(main, startup, loss, feeds)
+
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first, = exe.run(main, feed=feeds[0], fetch_list=[loss])
+        rest, = exe.run_steps(main, feed_list=feeds[1:], fetch_list=[loss])
+    mixed = np.concatenate([np.asarray(first).reshape(1, -1),
+                            np.asarray(rest).reshape(K - 1, -1)])
+    assert mixed.tobytes() == seq_losses.tobytes()
+
+
+def test_run_steps_data_parallel_matches_single_device():
+    from paddle_tpu.parallel.mesh import make_mesh
+    K = 4
+    feeds = _feeds(K, batch=16)
+    main, startup, loss = _train_model(seed=3, dropout=0.0)
+    seq_losses, _ = _run_sequential(main, startup, loss, feeds)
+
+    main2, startup2, loss2 = _train_model(seed=3, dropout=0.0)
+    exe = fluid.Executor(mesh=make_mesh(data=8))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup2)
+        stacked, = exe.run_steps(main2, feed_list=feeds,
+                                 fetch_list=[loss2])
+    np.testing.assert_allclose(stacked.reshape(K, -1), seq_losses,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_compiled_program_num_iteration_per_drop_scope():
+    # ExecutionStrategy.num_iteration_per_drop_scope=K + a list feed
+    # routes through run_steps, K iterations per launch, results stacked
+    # across ALL steps and bitwise equal to the sequential path
+    N, K = 5, 2
+    main, startup, loss = _train_model(dropout=0.4)
+    feeds = _feeds(N)
+    seq_losses, seq_scope = _run_sequential(main, startup, loss, feeds)
+
+    es = fluid.ExecutionStrategy()
+    es.num_iteration_per_drop_scope = K
+    compiled = fluid.CompiledProgram(main, exec_strategy=es)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, = exe.run(compiled, feed=feeds, fetch_list=[loss])
+    assert out.shape[0] == N
+    assert out.reshape(N, -1).tobytes() == seq_losses.tobytes()
+    for n in scope.vars:
+        assert np.asarray(scope.vars[n]).tobytes() == \
+            np.asarray(seq_scope.vars[n]).tobytes(), n
+
+
+def test_trainer_steps_per_launch_events_and_parity():
+    from paddle_tpu import layers
+
+    def reader():
+        rng = np.random.RandomState(0)
+        w = np.array([[1.5], [-2.0], [0.5]], 'float32')
+        for _ in range(7):   # 7 steps: 3 launches of K=3, 3, 1 (tail)
+            xb = rng.rand(4, 3).astype('float32')
+            yield [(x, (x[None, :] @ w)[0]) for x in xb]
+
+    def train_func():
+        x = layers.data('x', shape=[3], dtype='float32')
+        y = layers.data('y', shape=[1], dtype='float32')
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name='w'))
+        return layers.reduce_mean(layers.square(pred - y))
+
+    def run(steps_per_launch):
+        seen = {'begin': [], 'end': [], 'metrics': []}
+
+        def handler(ev):
+            if isinstance(ev, fluid.BeginStepEvent):
+                seen['begin'].append(ev.step)
+            elif isinstance(ev, fluid.EndStepEvent):
+                seen['end'].append(ev.step)
+                seen['metrics'].append(
+                    np.asarray(ev.metrics[0]).ravel()[0])
+
+        trainer = fluid.Trainer(
+            train_func, lambda: fluid.optimizer.SGDOptimizer(0.3))
+        trainer.train(1, handler, reader=lambda: reader(),
+                      feed_order=['x', 'y'],
+                      steps_per_launch=steps_per_launch)
+        return seen
+
+    single = run(1)
+    fused = run(3)
+    # events still fire per STEP, in order, with per-step metric values
+    assert fused['begin'] == single['begin'] == list(range(7))
+    assert fused['end'] == single['end'] == list(range(7))
+    np.testing.assert_array_equal(np.asarray(fused['metrics']),
+                                  np.asarray(single['metrics']))
+
+
+# ---------------------------------------------------------------- feed queue
+
+def test_feed_prefetcher_preserves_order_and_drains():
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    feeds = ({'x': np.full((2, 3), i, 'float32'),
+              'y': np.full((2,), -i, 'int64')} for i in range(10))
+    pf = FeedPrefetcher(feeds, steps=4, capacity=2, to_device=False)
+    got = list(pf)
+    assert [k for _, k in got] == [4, 4, 2]   # partial tail flushed
+    seen = []
+    for stacked, k in got:
+        assert stacked['x'].shape == (k, 2, 3)
+        seen.extend(stacked['x'][:, 0, 0].tolist())
+    assert seen == list(range(10)), 'prefetch must preserve feed order'
+    # a drained prefetcher yields nothing more and close() is idempotent
+    assert list(pf) == []
+    pf.close()
+    pf.close()
+
+
+def test_feed_prefetcher_device_put_superbatch():
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    feeds = [{'x': np.full((2,), i, 'float32')} for i in range(4)]
+    (stacked, k), = list(FeedPrefetcher(feeds, steps=4))
+    assert k == 4
+    assert hasattr(stacked['x'], 'devices'), \
+        'superbatch must be device-resident'
+    np.testing.assert_array_equal(np.asarray(stacked['x'])[:, 0],
+                                  [0, 1, 2, 3])
+
+
+def test_feed_prefetcher_propagates_reader_error():
+    from paddle_tpu.data_feeder import FeedPrefetcher
+
+    def gen():
+        yield {'x': np.zeros((2,), 'float32')}
+        yield {'x': np.ones((2,), 'float32')}
+        raise RuntimeError('reader exploded')
+
+    pf = FeedPrefetcher(gen(), steps=2, to_device=False)
+    it = iter(pf)
+    stacked, k = next(it)
+    assert k == 2
+    with pytest.raises(RuntimeError, match='reader exploded'):
+        next(it)
+
+
+def test_feed_prefetcher_key_mismatch_is_an_error():
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    feeds = [{'x': np.zeros(2, 'float32')}, {'y': np.zeros(2, 'float32')}]
+    with pytest.raises(ValueError, match='disagree'):
+        list(FeedPrefetcher(feeds, steps=2, to_device=False))
+
+
+def test_feed_prefetcher_feeds_run_steps():
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    K = 2
+    main, startup, loss = _train_model(dropout=0.0)
+    feeds = _feeds(4)
+    seq_losses, seq_scope = _run_sequential(main, startup, loss, feeds)
+
+    exe, scope = fluid.Executor(), fluid.Scope()
+    got = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for superbatch, k in FeedPrefetcher(feeds, steps=K):
+            out, = exe.run_steps(main, feed_list=superbatch, steps=k,
+                                 fetch_list=[loss])
+            got.append(out.reshape(k, -1))
+    assert np.concatenate(got).tobytes() == seq_losses.tobytes()
+    for n in scope.vars:
+        assert np.asarray(scope.vars[n]).tobytes() == \
+            np.asarray(seq_scope.vars[n]).tobytes(), n

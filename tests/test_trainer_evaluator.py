@@ -43,7 +43,7 @@ def test_trainer_train_test_save_infer(tmp_path):
     assert events['epochs'] == 3
     assert events['steps'] == 24
     test_loss, = trainer.test(_reader(), feed_order=['x', 'y'])
-    assert float(test_loss) < 0.5, test_loss
+    assert np.asarray(test_loss).ravel()[0] < 0.5, test_loss
 
     pdir = str(tmp_path / 'params')
     trainer.save_params(pdir)

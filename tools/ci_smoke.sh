@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# CI smoke: the tier-1 test command from ROADMAP.md, then a CPU bench.py
+# run whose JSON line is validated against the expected schema — bench
+# drift (a renamed or dropped key) fails fast instead of silently.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== ci_smoke: tier-1 tests =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+t1_rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+
+echo "== ci_smoke: bench.py JSON schema =="
+# tiny shapes: the smoke validates the schema, not the throughput
+bench_out=$(timeout -k 10 1200 env JAX_PLATFORMS=cpu BENCH_PROBE_TIMEOUT=60 \
+    BENCH_B=2 BENCH_T=16 BENCH_RESNET_B=1 BENCH_STEPS_PER_LAUNCH=2 \
+    python bench.py) || { echo "ci_smoke: bench.py FAILED"; exit 1; }
+echo "$bench_out"
+
+python - "$bench_out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1].strip().splitlines()[-1])
+expected = [
+    'metric', 'value', 'unit', 'vs_baseline', 'mfu', 'model_tflops_per_s',
+    'params_m', 'matmul_params_m', 'backend', 'batch', 'seq', 'amp',
+    'flash', 'steps_per_launch', 'single_step_tokens_per_sec',
+]
+missing = [k for k in expected if k not in rec]
+if missing:
+    sys.exit('ci_smoke: bench JSON is missing keys: %s' % missing)
+if rec['metric'] != 'transformer_base_tokens_per_sec_per_chip':
+    sys.exit('ci_smoke: unexpected headline metric %r' % rec['metric'])
+if not rec['steps_per_launch'] > 1:
+    sys.exit('ci_smoke: headline must run the fused multi-step loop '
+             '(steps_per_launch=%r)' % rec['steps_per_launch'])
+if not (isinstance(rec['value'], (int, float)) and rec['value'] > 0):
+    sys.exit('ci_smoke: bad headline value %r' % rec['value'])
+print('ci_smoke: bench JSON schema ok '
+      '(%d keys, steps_per_launch=%d)' % (len(rec), rec['steps_per_launch']))
+EOF
+schema_rc=$?
+
+if [ "$t1_rc" -ne 0 ]; then
+    echo "ci_smoke: tier-1 tests FAILED (rc=$t1_rc)"
+fi
+[ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ]
