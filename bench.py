@@ -264,6 +264,11 @@ def main():
         print('BENCH: %d-step fused compile+warmup ok (%.1fs)'
               % (K, time.perf_counter() - t0), file=sys.stderr)
         launches = max(1, steps // K)
+        # telemetry: snapshot AFTER warmup so the measured window is
+        # self-labeling — a retrace or pipeline stall during the timed
+        # loop lands in the JSON instead of silently polluting the number
+        import paddle_tpu.observability as obs
+        snap0 = obs.counters()
         t0 = time.perf_counter()
         for _ in range(launches):
             losses, = exe.run_steps(main_prog, feed_list=superfeed,
@@ -271,8 +276,36 @@ def main():
                                     return_numpy=False)
         np.asarray(losses)  # block
         dt = time.perf_counter() - t0
+        snap1 = obs.counters()
 
     tps = launches * K * tokens_per_step / dt
+
+    def delta(name):
+        return (snap1.get(name) or 0) - (snap0.get(name) or 0)
+
+    # the backend the bench process ACTUALLY ran on (the probe only says
+    # what a subprocess saw) — a CPU fallback can't masquerade as TPU
+    dev0 = jax.devices()[0]
+    telemetry = {
+        'platform': dev0.platform,
+        'device_kind': str(dev0.device_kind),
+        'retraces': int(delta('executor.retraces')),
+        'retraces_total': int(snap1.get('executor.retraces') or 0),
+        'compiles': int(snap1.get('executor.compiles') or 0),
+        'compile_s': round(snap1.get('executor.compile_s') or 0.0, 3),
+        'stall_count': int(delta('executor.stall_count')),
+        'prefetch_starvation_s': round(
+            snap1.get('prefetch.starvation_s') or 0.0, 3),
+        'fetch_sync_s': round(snap1.get('executor.fetch_sync_s') or 0.0, 3),
+    }
+    if telemetry['retraces']:
+        print('BENCH: WARNING — %d retrace(s) DURING the measured fused '
+              'loop; the number below is compile-polluted'
+              % telemetry['retraces'], file=sys.stderr)
+        rep = obs.explainer().last_report()
+        if rep:
+            print('BENCH: last retrace cause: %s'
+                  % '; '.join(rep['details']), file=sys.stderr)
 
     # model FLOPs (scaling-book accounting): 6*P per trained token for the
     # MATMUL params (embedding gathers excluded — they do no MXU work),
@@ -312,6 +345,7 @@ def main():
         'batch': B, 'seq': T, 'amp': True, 'flash': True,
         'steps_per_launch': K,
         'single_step_tokens_per_sec': round(tps_single, 1),
+        'telemetry': telemetry,
     }
     rec.update(resnet_rec)
     if fallback_reason:

@@ -13,6 +13,7 @@ merely deferring scope cleanup.
 """
 import numpy as np
 
+from . import observability as _obs
 from .core.executor import _CompiledProgramBase
 
 __all__ = ['CompiledProgram', 'BuildStrategy', 'ExecutionStrategy']
@@ -119,10 +120,19 @@ class CompiledProgram(_CompiledProgramBase):
                                     fetch_list=fetch_list, steps=k,
                                     return_numpy=return_numpy, **run_kwargs)
         chunks = [feed_list[i:i + k] for i in range(0, len(feed_list), k)]
-        outs = [runner.run_steps(self._program, feed_list=c,
-                                 fetch_list=fetch_list, steps=len(c),
-                                 return_numpy=return_numpy, **run_kwargs)
-                for c in chunks]
+        if _obs.enabled() and len(chunks) > 1 and len(chunks[-1]) != k:
+            # a ragged tail chunk lowers a SECOND executable (steps=len
+            # differs) — flag it on the timeline, it reads as a mystery
+            # compile otherwise
+            _obs.instant('compiled_program.ragged_tail', cat='compile',
+                         args={'k': k, 'tail': len(chunks[-1])})
+        with _obs.span('compiled_program.run_steps', cat='launch',
+                       chunks=len(chunks), k=k):
+            outs = [runner.run_steps(self._program, feed_list=c,
+                                     fetch_list=fetch_list, steps=len(c),
+                                     return_numpy=return_numpy,
+                                     **run_kwargs)
+                    for c in chunks]
         if len(outs) == 1:
             return outs[0]
         cat = np.concatenate if return_numpy else _jnp_concat

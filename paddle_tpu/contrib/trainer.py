@@ -7,6 +7,7 @@ checkpoint rotation (train/checkpoint.py), which all stay on the host.
 """
 import numpy as np
 
+from .. import observability as _obs
 from ..core import framework
 from ..core.executor import Executor, Scope, scope_guard
 from ..data_feeder import DataFeeder
@@ -36,10 +37,20 @@ class BeginStepEvent(object):
 
 
 class EndStepEvent(object):
-    def __init__(self, epoch_id, step_id, metrics):
+    """`telemetry` is a per-step snapshot of the observability counters/
+    gauges ({name: value}, None when telemetry is disabled) — event
+    handlers can watch executor.retraces / executor.stall_count /
+    prefetch.starvation_s climb live instead of post-mortem."""
+
+    def __init__(self, epoch_id, step_id, metrics, telemetry=None):
         self.epoch = epoch_id
         self.step = step_id
         self.metrics = metrics
+        self.telemetry = telemetry
+
+
+def _telemetry_snapshot():
+    return _obs.counters() if _obs.enabled() else None
 
 
 class CheckpointConfig(_CkptConfig):
@@ -133,13 +144,14 @@ class Trainer(object):
                                                  feed_list=buf,
                                                  fetch_list=fetch,
                                                  steps=len(buf))
+                    telem = _telemetry_snapshot()
                     for i in range(len(buf)):
                         metrics = [np.asarray(m[i]) for m in stacked]
                         if self.checkpointer:
                             self.checkpointer.maybe_save(epoch_id,
                                                          step_id + i)
                         event_handler(EndStepEvent(epoch_id, step_id + i,
-                                                   metrics))
+                                                   metrics, telemetry=telem))
                     return step_id + len(buf)
 
                 for data in reader():
@@ -177,7 +189,9 @@ class Trainer(object):
                                            fetch_list=fetch)
                     if self.checkpointer:
                         self.checkpointer.maybe_save(epoch_id, step_id)
-                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    event_handler(EndStepEvent(
+                        epoch_id, step_id, metrics,
+                        telemetry=_telemetry_snapshot()))
                 event_handler(EndEpochEvent(epoch_id))
 
     def test(self, reader, feed_order):

@@ -9,12 +9,14 @@ live on device in a Scope and are donated to the executable, so updates are
 in-place (input/output buffer aliasing) with zero copies.
 """
 import os
+import time
 
 import numpy as np
 
 from . import registry
 from .framework import (Variable, Parameter, default_main_program, TPUPlace,
                         Program)
+from .. import observability as _obs
 
 __all__ = ['Executor', 'Scope', 'scope_guard', 'global_scope']
 
@@ -318,6 +320,32 @@ def _analyze(block, feed_names, fetch_names):
 # cache key" directly instead of inferring it from cache sizes
 _TRACE_COUNT = [0]
 
+_program_serial_counter = itertools.count()
+
+
+def _program_serial(program):
+    """Process-unique program id for telemetry: unlike id(), never recycled,
+    and paired with _version so an in-place program edit reads as a change."""
+    serial = getattr(program, '_obs_serial', None)
+    if serial is None:
+        serial = next(_program_serial_counter)
+        program._obs_serial = serial
+    return (serial, program._version)
+
+
+def _launch_signature(program, feed_vals, feed_names, fetch_names, steps,
+                      check_nan, scope):
+    """Every component the lowering cache (and jax.jit under it) keys on,
+    structured so the retrace explainer can name what changed."""
+    return _obs.LaunchSignature(
+        program=_program_serial(program),
+        feed_shapes={n: tuple(np.shape(feed_vals[n])) for n in feed_names},
+        feed_dtypes={n: str(getattr(feed_vals[n], 'dtype',
+                                    type(feed_vals[n]).__name__))
+                     for n in feed_names},
+        fetch_set=fetch_names, steps=steps, check_nan=check_nan,
+        scope=scope._serial)
+
 
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
            out_shardings_for=None, check_nan=False, steps=None):
@@ -524,6 +552,8 @@ class Executor(object):
         self._cache = {}
         self._run_counter = {}
         self._shard_targets = {}
+        # telemetry span tags (ParallelExecutor sets mesh/shard info here)
+        self._obs_tags = {}
 
     def close(self):
         self._cache.clear()
@@ -648,6 +678,12 @@ class Executor(object):
         feed_names = tuple(sorted(feed_vals.keys()))
         fetch_names = tuple(self._resolve_fetch(fetch_list))
 
+        # telemetry: ONE flag check per launch; when off, the hot path
+        # below does no telemetry work (no spans, no counters, no dicts)
+        obs_on = _obs.enabled()
+        if obs_on:
+            _obs.on_launch_start(self, time.perf_counter())
+
         base_key = (id(program), program._version, feed_names, fetch_names,
                     scope._serial)
         key = base_key + (self.check_nan, steps)
@@ -655,11 +691,18 @@ class Executor(object):
         if entry is None:
             # the cached tuple keeps a strong ref to `program` so its id()
             # (part of the key) can never be recycled by a new Program
+            t_l0 = time.perf_counter() if obs_on else None
             entry = _lower(program, feed_names, fetch_names,
                            donate=True, mesh=self.mesh,
                            check_nan=self.check_nan, steps=steps) + (program,)
             if use_program_cache:
                 self._cache[key] = entry
+            if obs_on:
+                _obs.metrics.counter('executor.lowerings').inc()
+                _obs.tracing.add_span(
+                    'executor.lower', t_l0, time.perf_counter(),
+                    cat='compile',
+                    args=dict(self._obs_tags, steps=steps) or None)
         fn, params_in, writeback = entry[:3]
 
         params = {}
@@ -693,9 +736,32 @@ class Executor(object):
         counter = self._run_counter.get(base_key, 0)
         self._run_counter[base_key] = counter + (steps or 1)
 
+        if obs_on:
+            tc0 = _TRACE_COUNT[0]
+            t_d0 = time.perf_counter()
         result = fn(params,
                     {n: feed_vals[n] for n in feed_names},
                     np.uint32(counter & 0xffffffff))
+        if obs_on:
+            t_d1 = time.perf_counter()
+            _obs.metrics.counter('executor.launches').inc()
+            if _TRACE_COUNT[0] > tc0:
+                # this launch (re)traced+compiled: build the structured
+                # signature and let the explainer name what changed
+                sig = _launch_signature(program, feed_vals, feed_names,
+                                        fetch_names, steps, self.check_nan,
+                                        scope)
+                report = _obs.explainer().observe(sig, compile_s=t_d1 - t_d0)
+                _obs.tracing.add_span(
+                    'executor.trace_compile', t_d0, t_d1, cat='compile',
+                    args=dict(self._obs_tags, steps=steps,
+                              kind=report['kind'],
+                              cause='; '.join(report['details'])[:512]
+                              or None))
+            else:
+                _obs.tracing.add_span(
+                    'executor.dispatch', t_d0, t_d1, cat='launch',
+                    args=dict(self._obs_tags, steps=steps) or None)
         fetches, updates = result[0], result[1]
         # write back BEFORE the nan check: params were donated, so the old
         # scope arrays are dead — raising first would leave the scope
@@ -710,7 +776,33 @@ class Executor(object):
             self._assert_finite(itertools.chain(
                 zip(fetch_names, fetches), updates.items()))
         if return_numpy:
+            # the host-sync point of the launch: converting fetches blocks
+            # on the device — its duration is how long the async pipeline
+            # made the host wait (near-zero in steady state)
+            t_f0 = time.perf_counter() if obs_on else None
             fetches = [np.asarray(f) for f in fetches]
+            if obs_on:
+                t_f1 = time.perf_counter()
+                _obs.metrics.counter('executor.fetch_sync_s').inc(
+                    t_f1 - t_f0)
+                _obs.metrics.histogram('executor.fetch_sync_ms').observe(
+                    (t_f1 - t_f0) * 1000.0)
+                _obs.tracing.add_span('executor.fetch_sync', t_f0, t_f1,
+                                      cat='launch')
+        if obs_on:
+            # drop the donated input refs NOW, inside the launch window: on
+            # the CPU backend freeing a donated buffer blocks until its
+            # consuming execution completes, and at frame teardown that
+            # wait would land AFTER the end mark — misread as inter-launch
+            # host gap (phantom pipeline stalls).  On TPU the free is async
+            # and this is instant.
+            t_w0 = time.perf_counter()
+            params = None  # noqa: F841 - the free IS the point
+            t_w1 = time.perf_counter()
+            if t_w1 - t_w0 > 1e-4:
+                _obs.tracing.add_span('executor.donate_wait', t_w0, t_w1,
+                                      cat='launch')
+            _obs.on_launch_end(self, t_w1)
         return fetches
 
     @staticmethod

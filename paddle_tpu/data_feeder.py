@@ -10,12 +10,14 @@ device runs the current launch, so host->device transfer overlaps compute.
 """
 import queue
 import threading
+import time
 
 import numpy as np
 
 from .core.framework import Variable, default_main_program
 from .core.lod import create_lod_tensor
 from .core.dtypes import convert_dtype
+from . import observability as _obs
 
 __all__ = ['DataFeeder', 'FeedPrefetcher']
 
@@ -49,6 +51,9 @@ class FeedPrefetcher(object):
         self._to_device = to_device
         self._q = queue.Queue(maxsize=int(capacity))
         self._terminal = None   # ('done',) | ('error', exc) | ('closed',)
+        # telemetry: is the consumer currently blocked on an empty queue?
+        # (pack work done while it ISN'T waiting overlapped its compute)
+        self._consumer_waiting = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._worker, name='FeedPrefetcher', daemon=True)
@@ -60,11 +65,28 @@ class FeedPrefetcher(object):
             if set(f) != names:
                 raise ValueError('per-step feeds disagree on keys: %s vs %s'
                                  % (sorted(names), sorted(f)))
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else None
+        overlapped = obs_on and not self._consumer_waiting
         stacked = {k: np.stack([np.asarray(f[k]) for f in buf])
                    for k in buf[0]}
         if self._to_device:
             import jax
             stacked = jax.device_put(stacked)
+        if obs_on:
+            dt = time.perf_counter() - t0
+            _obs.metrics.counter('prefetch.superbatches').inc()
+            _obs.metrics.counter('prefetch.upload_s').inc(dt)
+            if overlapped:
+                # stacking+upload ran while the consumer was busy running
+                # the previous launch — the overlap the prefetcher exists
+                # to buy.  Upload time with the consumer parked on the
+                # queue is exposed transfer latency instead.
+                _obs.metrics.counter('prefetch.upload_overlap_s').inc(dt)
+            _obs.tracing.add_span('prefetch.pack', t0, time.perf_counter(),
+                                  cat='prefetch',
+                                  args={'steps': len(buf),
+                                        'overlapped': overlapped})
         return stacked, len(buf)
 
     def _put(self, item):
@@ -73,6 +95,9 @@ class FeedPrefetcher(object):
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
+                if _obs.enabled():
+                    _obs.metrics.gauge('prefetch.queue_depth').set(
+                        self._q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -102,7 +127,25 @@ class FeedPrefetcher(object):
                 # exhausted/errored/closed: iterating again yields nothing
                 # instead of blocking on a queue no worker will ever fill
                 return
+            obs_on = _obs.enabled()
+            starved = obs_on and self._q.empty()
+            if obs_on:
+                self._consumer_waiting = True
+                t0 = time.perf_counter()
             kind, payload = self._q.get()
+            if obs_on:
+                self._consumer_waiting = False
+                _obs.metrics.gauge('prefetch.queue_depth').set(
+                    self._q.qsize())
+                if starved:
+                    # the training loop wanted the next superbatch and the
+                    # queue was empty: the reader is the bottleneck
+                    wait = time.perf_counter() - t0
+                    _obs.metrics.counter('prefetch.starvation_count').inc()
+                    _obs.metrics.counter('prefetch.starvation_s').inc(wait)
+                    _obs.tracing.add_span(
+                        'prefetch.starved', t0, time.perf_counter(),
+                        cat='prefetch')
             if kind == 'done':
                 self._terminal = ('done',)
                 return

@@ -1,0 +1,52 @@
+"""paddle_tpu.observability — in-process runtime telemetry.
+
+Always-cheap instrumentation woven through the execution stack (see
+docs/observability.md for the metric catalog and span taxonomy):
+
+  * metrics   — counters/gauges/histograms, thread-safe, snapshot-to-dict,
+                near-zero-overhead no-op mode (PT_OBS=0 or disable())
+  * tracing   — span/event recorder exporting Chrome-trace/Perfetto JSON
+  * retrace   — the retrace explainer: every (re)trace diffs its launch
+                signature against the nearest prior one and names which
+                cache-key component changed
+  * stall     — launch-gap histogram + pipeline-drain detection
+
+Everything is process-global: one training process is one telemetry
+stream.  `snapshot()` returns the whole state as one dict; `reset()`
+clears it (profiler.reset_profiler routes here).
+"""
+from . import metrics  # noqa
+from . import tracing  # noqa
+from . import retrace  # noqa
+from . import stall  # noqa
+
+from .metrics import (enabled, enable, disable, counter, gauge,  # noqa
+                      histogram, metrics_snapshot, counters, registry)
+from .tracing import (span, instant, add_span, export_chrome_trace,  # noqa
+                      span_summary, recorder)
+from .retrace import LaunchSignature, explainer  # noqa
+from .stall import (on_launch_start, on_launch_end,  # noqa
+                    stall_threshold_ms, set_stall_threshold_ms)
+
+__all__ = ['metrics', 'tracing', 'retrace', 'stall', 'enabled', 'enable',
+           'disable', 'counter', 'gauge', 'histogram', 'metrics_snapshot',
+           'counters', 'registry', 'span', 'instant', 'add_span',
+           'export_chrome_trace', 'span_summary', 'recorder',
+           'LaunchSignature', 'explainer', 'on_launch_start',
+           'on_launch_end', 'stall_threshold_ms', 'set_stall_threshold_ms',
+           'snapshot', 'reset']
+
+
+def snapshot():
+    """Full telemetry dump: metrics + span summary + retrace reports."""
+    snap = metrics.metrics_snapshot()
+    snap['spans'] = tracing.span_summary()
+    snap['retrace_reports'] = list(retrace.explainer().reports)
+    return snap
+
+
+def reset():
+    """Clear every recorded metric, span, and retrace report."""
+    metrics.reset()
+    tracing.reset()
+    retrace.reset()

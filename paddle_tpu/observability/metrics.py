@@ -1,0 +1,195 @@
+"""Metrics registry: counters / gauges / histograms, thread-safe,
+snapshot-to-dict, with a near-zero-overhead no-op mode.
+
+The registry is process-global (one training process = one telemetry
+stream, matching the one-executable-per-step execution model).  Hot
+paths guard with `enabled()` ONCE per launch and skip every telemetry
+call when off, so disabled mode costs a single branch — individual
+metric mutators also check the flag as a second line of defense for
+call sites that don't batch their guard.
+
+Histogram buckets are power-of-two (frexp exponent): cheap to compute,
+wide dynamic range, good enough to tell a 2 ms launch gap from a 200 ms
+pipeline drain.
+"""
+import math
+import os
+import threading
+
+__all__ = ['enabled', 'enable', 'disable', 'Counter', 'Gauge', 'Histogram',
+           'MetricsRegistry', 'registry', 'counter', 'gauge', 'histogram',
+           'metrics_snapshot', 'counters', 'reset']
+
+_ENABLED = [os.environ.get('PT_OBS', '1') not in ('0', 'false', 'False')]
+
+
+def enabled():
+    return _ENABLED[0]
+
+
+def enable():
+    _ENABLED[0] = True
+
+
+def disable():
+    _ENABLED[0] = False
+
+
+class Counter(object):
+    """Monotonic accumulator (float, so it also serves as a seconds sink)."""
+    __slots__ = ('name', 'value', '_lock')
+
+    def __init__(self, name):
+        self.name = name
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1.0):
+        if not _ENABLED[0]:
+            return
+        with self._lock:
+            self.value += amount
+
+    def snapshot(self):
+        return self.value
+
+
+class Gauge(object):
+    """Last-value metric (queue depth, overlap fraction)."""
+    __slots__ = ('name', 'value', 'updates', '_lock')
+
+    def __init__(self, name):
+        self.name = name
+        self.value = None
+        self.updates = 0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        if not _ENABLED[0]:
+            return
+        with self._lock:
+            self.value = value
+            self.updates += 1
+
+    def snapshot(self):
+        return self.value
+
+
+class Histogram(object):
+    """count/sum/min/max plus power-of-two buckets keyed by the frexp
+    exponent e (bucket e holds values in (2^(e-1), 2^e])."""
+    __slots__ = ('name', 'count', 'total', 'min', 'max', 'buckets', '_lock')
+
+    def __init__(self, name):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self.buckets = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        if not _ENABLED[0]:
+            return
+        value = float(value)
+        e = math.frexp(value)[1] if value > 0.0 else 0
+        with self._lock:
+            self.count += 1
+            self.total += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            self.buckets[e] = self.buckets.get(e, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            if not self.count:
+                return {'count': 0}
+            return {'count': self.count, 'sum': self.total,
+                    'min': self.min, 'max': self.max,
+                    'mean': self.total / self.count,
+                    'buckets': {'le_2^%d' % e: n
+                                for e, n in sorted(self.buckets.items())}}
+
+
+class MetricsRegistry(object):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, name, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError('metric %r already registered as %s'
+                            % (name, type(m).__name__))
+        return m
+
+    def counter(self, name):
+        return self._get(name, Counter)
+
+    def gauge(self, name):
+        return self._get(name, Gauge)
+
+    def histogram(self, name):
+        return self._get(name, Histogram)
+
+    def snapshot(self):
+        """Full structured dump: {'counters': {...}, 'gauges': {...},
+        'histograms': {...}}."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out = {'counters': {}, 'gauges': {}, 'histograms': {}}
+        for name, m in items:
+            kind = ('counters' if isinstance(m, Counter) else
+                    'gauges' if isinstance(m, Gauge) else 'histograms')
+            out[kind][name] = m.snapshot()
+        return out
+
+    def counters(self):
+        """Flat {name: value} over counters AND gauges (the shape bench.py
+        and tests diff against)."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in items
+                if isinstance(m, (Counter, Gauge))}
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry():
+    return _REGISTRY
+
+
+def counter(name):
+    return _REGISTRY.counter(name)
+
+
+def gauge(name):
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name):
+    return _REGISTRY.histogram(name)
+
+
+def metrics_snapshot():
+    return _REGISTRY.snapshot()
+
+
+def counters():
+    return _REGISTRY.counters()
+
+
+def reset():
+    _REGISTRY.reset()

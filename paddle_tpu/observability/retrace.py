@@ -1,0 +1,157 @@
+"""Retrace explainer: names WHY an executable was (re)traced.
+
+The Julia->TPU compile-the-loop model (arxiv 1810.09868) has one silent
+failure mode: an unnoticed recompile.  Under whole-block lowering a
+retrace can come from two layers — an executor-cache miss (new fetch
+set, steps=K, program edit) or a jax.jit shape/dtype miss under an
+existing cache entry — and both surface here the same way: the executor
+detects a trace via `_TRACE_COUNT`, builds a `LaunchSignature` of every
+cache-key component, and the explainer diffs it against the NEAREST
+prior signature (fewest differing components) to record which component
+changed: feed shapes, feed dtypes, fetch set, steps, program serial,
+check_nan, scope.
+
+A signature whose nearest prior differs in `program` is a new-program
+compile (expected; counted in `executor.compiles`); anything else is a
+retrace (`executor.retraces`) with its cause named in the report and an
+instant event dropped on the timeline.  `executor.compile_s` accumulates
+trace+compile wall time for both kinds.
+"""
+import threading
+from collections import deque
+
+from . import metrics
+from . import tracing
+
+__all__ = ['LaunchSignature', 'RetraceExplainer', 'explainer', 'reset']
+
+_COMPONENTS = ('program', 'feed_shapes', 'feed_dtypes', 'fetch_set',
+               'steps', 'check_nan', 'scope')
+
+
+class LaunchSignature(object):
+    """Structured cache key: one attribute per component the executor's
+    lowering cache (and jax.jit underneath it) keys on."""
+    __slots__ = _COMPONENTS
+
+    def __init__(self, program, feed_shapes, feed_dtypes, fetch_set,
+                 steps, check_nan, scope):
+        self.program = program            # (serial, version)
+        self.feed_shapes = dict(feed_shapes)   # name -> tuple
+        self.feed_dtypes = dict(feed_dtypes)   # name -> str
+        self.fetch_set = tuple(fetch_set)
+        self.steps = steps
+        self.check_nan = bool(check_nan)
+        self.scope = scope
+
+    def changed_components(self, other):
+        return [c for c in _COMPONENTS
+                if getattr(self, c) != getattr(other, c)]
+
+    def explain_against(self, other):
+        """Human-readable per-component details of self vs other."""
+        details = []
+        if self.program != other.program:
+            details.append('program: %r -> %r' % (other.program,
+                                                  self.program))
+        for label, new, old in (('feed_shape', self.feed_shapes,
+                                 other.feed_shapes),
+                                ('feed_dtype', self.feed_dtypes,
+                                 other.feed_dtypes)):
+            for n in sorted(set(new) | set(old)):
+                if n not in old:
+                    details.append('%s:%s added %r' % (label, n, new[n]))
+                elif n not in new:
+                    details.append('%s:%s removed (was %r)'
+                                   % (label, n, old[n]))
+                elif new[n] != old[n]:
+                    details.append('%s:%s %r -> %r'
+                                   % (label, n, old[n], new[n]))
+        if self.fetch_set != other.fetch_set:
+            added = [n for n in self.fetch_set if n not in other.fetch_set]
+            removed = [n for n in other.fetch_set if n not in self.fetch_set]
+            details.append('fetch_set: %s%s' % (
+                ' '.join('+' + n for n in added),
+                (' ' if added else '') + ' '.join('-' + n for n in removed)))
+        if self.steps != other.steps:
+            details.append('steps: %r -> %r' % (other.steps, self.steps))
+        if self.check_nan != other.check_nan:
+            details.append('check_nan: %r -> %r'
+                           % (other.check_nan, self.check_nan))
+        if self.scope != other.scope:
+            details.append('scope: serial %r -> %r'
+                           % (other.scope, self.scope))
+        return details
+
+
+class RetraceExplainer(object):
+    def __init__(self, max_reports=1000):
+        self._lock = threading.Lock()
+        self._seen = []
+        self.reports = deque(maxlen=max_reports)
+
+    def observe(self, sig, compile_s=0.0, label=None):
+        """Record one (re)trace; returns the report dict."""
+        with self._lock:
+            if not self._seen:
+                kind, changed, details = 'initial_compile', [], []
+            else:
+                nearest = min(self._seen,
+                              key=lambda s: len(sig.changed_components(s)))
+                changed = sig.changed_components(nearest)
+                details = sig.explain_against(nearest)
+                if 'program' in changed:
+                    kind = 'new_program_compile'
+                elif changed:
+                    kind = 'retrace'
+                else:
+                    # identical signature traced again: the executor cache
+                    # was bypassed or jit's own cache dropped the trace
+                    kind = 'retrace'
+                    details = ['identical signature retraced (cache '
+                               'bypassed or jit cache evicted)']
+            self._seen.append(sig)
+        report = {'kind': kind, 'changed': changed, 'details': details,
+                  'compile_s': compile_s, 'label': label}
+        self.reports.append(report)
+        if kind == 'retrace':
+            metrics.counter('executor.retraces').inc()
+            tracing.instant('executor.retrace', cat='compile',
+                            args={'cause': '; '.join(details) or 'unknown'})
+        else:
+            metrics.counter('executor.compiles').inc()
+        metrics.counter('executor.compile_s').inc(compile_s)
+        return report
+
+    def last_report(self):
+        return self.reports[-1] if self.reports else None
+
+    def render_report(self, report=None):
+        """One retrace-explainer report as text (docs/observability.md
+        shows the shape)."""
+        report = report or self.last_report()
+        if report is None:
+            return '<no traces recorded>'
+        lines = ['[%s] compile_s=%.3f%s'
+                 % (report['kind'], report['compile_s'],
+                    ' label=%s' % report['label'] if report['label']
+                    else '')]
+        for d in report['details']:
+            lines.append('  changed: %s' % d)
+        return '\n'.join(lines)
+
+    def reset(self):
+        with self._lock:
+            self._seen = []
+            self.reports.clear()
+
+
+_EXPLAINER = RetraceExplainer()
+
+
+def explainer():
+    return _EXPLAINER
+
+
+def reset():
+    _EXPLAINER.reset()

@@ -1,0 +1,49 @@
+"""Pipeline-stall detection: launch-gap histogram + drain events.
+
+In steady-state fused training the host's only job between device
+launches is handing the next superbatch to `run_steps`; any sizable
+host-side gap between the end of one launch call and the start of the
+next means the async pipeline drained (slow reader, synchronous fetch,
+accidental host round-trip).  The executor reports both edges here; the
+gap lands in the `executor.launch_gap_ms` histogram and, above the
+threshold (PT_OBS_STALL_MS, default 100 ms), increments
+`executor.stall_count` and drops a `pipeline.stall` instant event on the
+timeline so the drain is a recorded fact with a timestamp.
+"""
+import os
+
+from . import metrics
+from . import tracing
+
+__all__ = ['on_launch_start', 'on_launch_end', 'stall_threshold_ms',
+           'set_stall_threshold_ms']
+
+_STALL_MS = [float(os.environ.get('PT_OBS_STALL_MS', '100'))]
+
+
+def stall_threshold_ms():
+    return _STALL_MS[0]
+
+
+def set_stall_threshold_ms(ms):
+    _STALL_MS[0] = float(ms)
+
+
+def on_launch_start(owner, t_start):
+    """Called at the top of a launch with time.perf_counter(); `owner`
+    (an Executor) carries the previous launch-end mark."""
+    prev_end = getattr(owner, '_obs_prev_launch_end', None)
+    if prev_end is None:
+        return
+    gap_ms = (t_start - prev_end) * 1000.0
+    metrics.histogram('executor.launch_gap_ms').observe(gap_ms)
+    if gap_ms > _STALL_MS[0]:
+        metrics.counter('executor.stall_count').inc()
+        metrics.counter('executor.stall_s').inc(gap_ms / 1000.0)
+        tracing.instant('pipeline.stall', cat='stall',
+                        args={'gap_ms': round(gap_ms, 3),
+                              'threshold_ms': _STALL_MS[0]})
+
+
+def on_launch_end(owner, t_end):
+    owner._obs_prev_launch_end = t_end

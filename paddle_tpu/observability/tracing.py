@@ -1,0 +1,152 @@
+"""Span/event recorder emitting Chrome-trace / Perfetto-compatible JSON.
+
+Reference parity: Fluid's profiler writes a chrome-tracing timeline
+(`python/paddle/fluid/profiler.py` + tools/timeline.py); here the
+recorder is in-process and always-on-cheap — spans are plain dicts in a
+bounded deque, exported on demand as a `{"traceEvents": [...]}` file
+that loads directly in Perfetto (ui.perfetto.dev) or chrome://tracing.
+
+Timestamps are microseconds relative to a per-process perf_counter
+epoch, so `ts` is monotonic and durations are wall-accurate; events are
+sorted by `ts` at export time (completion order != start order for
+nested spans).
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .metrics import enabled
+
+__all__ = ['TraceRecorder', 'recorder', 'span', 'instant', 'add_span',
+           'export_chrome_trace', 'span_summary', 'reset']
+
+_EPOCH = time.perf_counter()
+_PID = os.getpid()
+_MAX_EVENTS = int(os.environ.get('PT_OBS_MAX_EVENTS', '200000'))
+
+
+def _us(pc_seconds):
+    """perf_counter seconds -> microseconds since the recorder epoch."""
+    return (pc_seconds - _EPOCH) * 1e6
+
+
+class TraceRecorder(object):
+    def __init__(self, max_events=_MAX_EVENTS):
+        self._lock = threading.Lock()
+        self._events = deque(maxlen=max_events)
+        self._dropped = 0
+
+    def add_complete(self, name, start_pc, end_pc, cat='runtime', args=None):
+        """One 'X' (complete) event spanning [start_pc, end_pc] — raw
+        time.perf_counter() values."""
+        ev = {'name': name, 'ph': 'X', 'cat': cat,
+              'ts': _us(start_pc), 'dur': max(0.0, (end_pc - start_pc) * 1e6),
+              'pid': _PID, 'tid': threading.get_ident()}
+        if args:
+            ev['args'] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+
+    def add_instant(self, name, cat='runtime', args=None):
+        ev = {'name': name, 'ph': 'i', 's': 't', 'cat': cat,
+              'ts': _us(time.perf_counter()),
+              'pid': _PID, 'tid': threading.get_ident()}
+        if args:
+            ev['args'] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+
+    def events(self):
+        with self._lock:
+            evs = list(self._events)
+        return sorted(evs, key=lambda e: e['ts'])
+
+    def event_count(self):
+        with self._lock:
+            return len(self._events)
+
+    def export(self, path):
+        """Write Chrome-trace JSON (Perfetto-loadable).  Returns the path."""
+        payload = {'traceEvents': self.events(), 'displayTimeUnit': 'ms'}
+        if self._dropped:
+            payload['otherData'] = {'dropped_events': self._dropped}
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, 'w') as f:
+            json.dump(payload, f)
+        return path
+
+    def summary(self):
+        """Aggregate complete events by name:
+        {name: {calls, total_us, min_us, max_us, ave_us}} — the table
+        behind profiler.profiler(sorted_key=...)."""
+        agg = {}
+        for ev in self.events():
+            if ev['ph'] != 'X':
+                continue
+            s = agg.setdefault(ev['name'], {'calls': 0, 'total_us': 0.0,
+                                            'min_us': None, 'max_us': 0.0})
+            d = ev['dur']
+            s['calls'] += 1
+            s['total_us'] += d
+            s['min_us'] = d if s['min_us'] is None else min(s['min_us'], d)
+            s['max_us'] = max(s['max_us'], d)
+        for s in agg.values():
+            s['ave_us'] = s['total_us'] / s['calls']
+        return agg
+
+    def reset(self):
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+
+_RECORDER = TraceRecorder()
+
+
+def recorder():
+    return _RECORDER
+
+
+@contextlib.contextmanager
+def span(name, cat='runtime', **args):
+    """Record a complete event around the with-block (no-op when disabled)."""
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _RECORDER.add_complete(name, t0, time.perf_counter(), cat,
+                               args or None)
+
+
+def add_span(name, start_pc, end_pc, cat='runtime', args=None):
+    if enabled():
+        _RECORDER.add_complete(name, start_pc, end_pc, cat, args)
+
+
+def instant(name, cat='runtime', args=None):
+    if enabled():
+        _RECORDER.add_instant(name, cat, args)
+
+
+def export_chrome_trace(path):
+    return _RECORDER.export(path)
+
+
+def span_summary():
+    return _RECORDER.summary()
+
+
+def reset():
+    _RECORDER.reset()

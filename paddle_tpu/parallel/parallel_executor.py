@@ -27,6 +27,14 @@ class ParallelExecutor(object):
         import jax
         self._mesh = mesh or make_mesh(data=len(jax.devices()))
         self._exe = Executor(mesh=self._mesh)
+        # tag every span this executor records with the mesh/shard layout,
+        # so a timeline mixing single-chip and mesh launches stays legible
+        self._exe._obs_tags = {
+            'mesh_axes': ','.join(str(a) for a in self._mesh.axis_names),
+            'mesh_shape': 'x'.join(str(s)
+                                   for s in self._mesh.devices.shape),
+            'devices': int(np.prod(self._mesh.devices.shape)),
+        }
         if share_vars_from is not None:
             self._scope = share_vars_from._scope
 

@@ -1,17 +1,31 @@
-"""Profiler (parity: python/paddle/fluid/profiler.py) over jax.profiler.
+"""Profiler (parity: python/paddle/fluid/profiler.py) over jax.profiler +
+the in-process observability recorder (paddle_tpu/observability/).
 
 cuda_profiler/profiler/start_profiler map to XLA trace capture; traces are
-viewable in TensorBoard / Perfetto (xplane), replacing the reference's
-nvprof/chrome-tracing output.
+viewable in TensorBoard / Perfetto (xplane).  On stop the recorder's own
+span timeline is ALSO written as `paddle_tpu_trace.json` into the trace
+dir — a Chrome-trace file that loads directly in ui.perfetto.dev or
+chrome://tracing, replacing the reference's chrome-tracing output.
+
+`profiler(sorted_key=...)` prints the reference-style sorted summary
+table (Event / Calls / Total / Min / Max / Ave / Ratio) aggregated from
+the recorded spans; `reset_profiler()` clears recorded spans, counters,
+and retrace reports (reference parity: it clears the event buffers).
 """
 import contextlib
+import os
 import time
 
+from . import observability as _obs
+
 __all__ = ['cuda_profiler', 'reset_profiler', 'profiler', 'start_profiler',
-           'stop_profiler']
+           'stop_profiler', 'print_summary']
 
 _trace_dir = ['/tmp/paddle_tpu_profile']
 _active = [False]
+
+_SORT_FIELDS = {'calls': 'calls', 'total': 'total_us', 'min': 'min_us',
+                'max': 'max_us', 'ave': 'ave_us'}
 
 
 @contextlib.contextmanager
@@ -21,7 +35,8 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 
 def reset_profiler():
-    pass
+    """Clear every recorded span, counter, and retrace report."""
+    _obs.reset()
 
 
 def start_profiler(state='All', tracer_option=None):
@@ -36,12 +51,48 @@ def stop_profiler(sorted_key=None, profile_path=None):
     if _active[0]:
         jax.profiler.stop_trace()
         _active[0] = False
+        try:
+            _obs.export_chrome_trace(
+                os.path.join(_trace_dir[0], 'paddle_tpu_trace.json'))
+        except OSError:
+            pass  # trace dir unwritable: the xplane dump already failed too
         print('[paddle_tpu.profiler] trace written to %s' % _trace_dir[0])
+    if sorted_key:
+        print_summary(sorted_key)
+
+
+def print_summary(sorted_key='total', limit=50):
+    """Reference-style sorted op-stat table over the recorded spans.
+
+    sorted_key: 'calls' | 'total' | 'min' | 'max' | 'ave' (the reference
+    profiler's sorted_key values); anything else keeps insertion order.
+    """
+    summary = _obs.span_summary()
+    rows = list(summary.items())
+    field = _SORT_FIELDS.get(sorted_key)
+    if field:
+        rows.sort(key=lambda kv: kv[1][field], reverse=True)
+    grand_total = sum(s['total_us'] for _, s in rows) or 1.0
+    print('------------------------->'
+          '     Profiling Report     <-------------------------')
+    print('%-32s %8s %12s %12s %12s %12s %8s'
+          % ('Event', 'Calls', 'Total(ms)', 'Min(ms)', 'Max(ms)',
+             'Ave(ms)', 'Ratio'))
+    for name, s in rows[:limit]:
+        print('%-32s %8d %12.3f %12.3f %12.3f %12.3f %7.2f%%'
+              % (name[:32], s['calls'], s['total_us'] / 1e3,
+                 s['min_us'] / 1e3, s['max_us'] / 1e3, s['ave_us'] / 1e3,
+                 100.0 * s['total_us'] / grand_total))
+    if not rows:
+        print('  <no spans recorded>')
 
 
 @contextlib.contextmanager
 def profiler(state='All', sorted_key=None, profile_path=None,
              output_file=None):
+    # _trace_dir is restored on exit: a scoped profile_path must not
+    # permanently redirect every later start_profiler() call
+    old_dir = _trace_dir[0]
     if profile_path or output_file:
         _trace_dir[0] = profile_path or output_file
     start_profiler(state)
@@ -49,5 +100,8 @@ def profiler(state='All', sorted_key=None, profile_path=None,
     try:
         yield
     finally:
-        stop_profiler(sorted_key, profile_path)
-        print('[paddle_tpu.profiler] wall %.3fs' % (time.time() - t0))
+        try:
+            stop_profiler(sorted_key, profile_path)
+            print('[paddle_tpu.profiler] wall %.3fs' % (time.time() - t0))
+        finally:
+            _trace_dir[0] = old_dir

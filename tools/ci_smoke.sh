@@ -29,7 +29,7 @@ rec = json.loads(sys.argv[1].strip().splitlines()[-1])
 expected = [
     'metric', 'value', 'unit', 'vs_baseline', 'mfu', 'model_tflops_per_s',
     'params_m', 'matmul_params_m', 'backend', 'batch', 'seq', 'amp',
-    'flash', 'steps_per_launch', 'single_step_tokens_per_sec',
+    'flash', 'steps_per_launch', 'single_step_tokens_per_sec', 'telemetry',
 ]
 missing = [k for k in expected if k not in rec]
 if missing:
@@ -41,8 +41,28 @@ if not rec['steps_per_launch'] > 1:
              '(steps_per_launch=%r)' % rec['steps_per_launch'])
 if not (isinstance(rec['value'], (int, float)) and rec['value'] > 0):
     sys.exit('ci_smoke: bad headline value %r' % rec['value'])
-print('ci_smoke: bench JSON schema ok '
-      '(%d keys, steps_per_launch=%d)' % (len(rec), rec['steps_per_launch']))
+
+tel = rec['telemetry']
+tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
+                'compiles', 'compile_s', 'stall_count',
+                'prefetch_starvation_s', 'fetch_sync_s']
+tel_missing = [k for k in tel_expected if k not in tel]
+if tel_missing:
+    sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
+if not tel['platform']:
+    sys.exit('ci_smoke: telemetry.platform is empty — the bench no longer '
+             'self-labels the backend it ran on')
+if tel['retraces'] > 0:
+    sys.exit('ci_smoke: bench reports %d retrace(s) AFTER warmup — the '
+             'fused loop recompiled mid-measurement (retrace regression)'
+             % tel['retraces'])
+if tel['compiles'] < 1:
+    sys.exit('ci_smoke: telemetry.compiles=%r — executor instrumentation '
+             'recorded no compiles at all' % tel['compiles'])
+print('ci_smoke: bench JSON schema ok (%d keys, steps_per_launch=%d, '
+      'platform=%s, retraces=%d after warmup)'
+      % (len(rec), rec['steps_per_launch'], tel['platform'],
+         tel['retraces']))
 EOF
 schema_rc=$?
 
