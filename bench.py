@@ -276,6 +276,13 @@ def main():
                                     return_numpy=False)
         np.asarray(losses)  # block
         dt = time.perf_counter() - t0
+        # ragged tail: a partial superbatch (steps=1 < K) must route
+        # through the already-compiled single-step executable (tail
+        # split) instead of lowering a fresh steps=1 scan — any trace
+        # here lands in the retraces-after-warmup check below
+        tailfeed = {k: v[:1] for k, v in superfeed.items()}
+        exe.run_steps(main_prog, feed_list=tailfeed, steps=1,
+                      fetch_list=[out['loss']], return_numpy=False)
         snap1 = obs.counters()
 
     tps = launches * K * tokens_per_step / dt
@@ -293,6 +300,18 @@ def main():
         'retraces_total': int(snap1.get('executor.retraces') or 0),
         'compiles': int(snap1.get('executor.compiles') or 0),
         'compile_s': round(snap1.get('executor.compile_s') or 0.0, 3),
+        # warm-start accounting (core/compile_cache.py): cold = seconds
+        # actually spent tracing+compiling this process; warm = seconds
+        # spent loading AOT executables the persistent cache already had.
+        # A second run over the same PT_CACHE_DIR must show hits > 0 and
+        # compile_s(_cold) collapsing — ci_smoke asserts exactly that.
+        'compile_s_cold': round(snap1.get('executor.compile_s') or 0.0, 3),
+        'compile_s_warm': round(snap1.get('compile_cache.load_s') or 0.0, 3),
+        'compile_cache_hits': int(
+            snap1.get('compile_cache.disk_hits') or 0),
+        'compile_cache_misses': int(
+            snap1.get('compile_cache.disk_misses') or 0),
+        'tail_splits': int(snap1.get('executor.tail_splits') or 0),
         'stall_count': int(delta('executor.stall_count')),
         'prefetch_starvation_s': round(
             snap1.get('prefetch.starvation_s') or 0.0, 3),

@@ -38,7 +38,7 @@ from . import io  # noqa
 from . import profiler  # noqa
 from . import param_attr  # noqa
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa
-from .data_feeder import DataFeeder, FeedPrefetcher  # noqa
+from .data_feeder import DataFeeder, FeedPrefetcher, FeedBucketer  # noqa
 from . import reader  # noqa
 from .batch import batch  # noqa
 from .io import (save_inference_model, load_inference_model,  # noqa

@@ -73,6 +73,38 @@ class CompiledProgram(_CompiledProgramBase):
     def with_inference_optimize(self, config):
         return self
 
+    def prewarm(self, exe, feed, fetch_list, scope=None, steps=None):
+        """AOT pre-warm (core/compile_cache.py): compile — or load from
+        the persistent cache — every executable this program will need
+        for the given feed signature, before the first real batch.
+
+        `feed` maps name -> example array or (shape, dtype) spec.  With
+        `steps=None` the fused K from num_iteration_per_drop_scope is
+        used; both the K-step scan AND the single-step executable are
+        prepared (the single-step one also serves ragged tails, which
+        Executor.run_steps routes through it).  Pass an explicit list of
+        step counts to control exactly what gets compiled.
+
+        Returns the list of disk fingerprints (None entries when the
+        persistent tier is disabled)."""
+        k = self._steps_per_launch
+        if steps is None:
+            plan = [None] if k <= 1 else [None, k]
+        elif isinstance(steps, (list, tuple)):
+            plan = list(steps)
+        else:
+            plan = [steps]
+        with _obs.span('compiled_program.prewarm', cat='compile',
+                       plan=str(plan)):
+            if self._data_parallel:
+                pe = self._pe_for(exe)
+                return [pe.prepare(self._program, feed=feed,
+                                   fetch_list=fetch_list, steps=s)
+                        for s in plan]
+            return [exe.prepare(self._program, feed=feed,
+                                fetch_list=fetch_list, scope=scope, steps=s)
+                    for s in plan]
+
     @property
     def _steps_per_launch(self):
         es = self._exec_strategy

@@ -1,0 +1,309 @@
+"""Compilation persistence: fingerprints, the bounded executable LRU, and
+the on-disk AOT cache that warm-starts fresh processes.
+
+The Julia->TPU compile-the-loop model (arxiv 1810.09868) treats the whole
+program as one ahead-of-time compilation artifact.  This module gives
+paddle_tpu the same property: every lowered executable is addressed by a
+**canonical fingerprint** — a stable hash over the serialized ProgramDesc,
+the launch signature (feed shapes/dtypes, fetch set, steps=K, mesh layout,
+param specs, AMP policy, check_nan) and the environment (jax/jaxlib
+version, backend platform + chip kind) — and stored in two tiers:
+
+  L1  in-process map, LRU-bounded by ``PT_EXEC_CACHE_MAX`` (default 64).
+      Evictions count into the ``pt_exec_cache_evictions`` metric; the
+      seed executor grew this map without limit across programs.
+  L2  on-disk store under ``PT_CACHE_DIR`` (default ``~/.cache/paddle_tpu``)
+      holding executables serialized through JAX's AOT path
+      (``jit(fn).lower(...).compile()`` + ``serialize_executable``).  A
+      backend that cannot serialize executables falls back to caching the
+      lowered StableHLO text — inspectable, and the XLA-level persistent
+      cache (``jax_compilation_cache_dir``, wired below as the backstop)
+      still shortcuts the backend compile on the retrace.
+
+Corrupt, truncated, or version-mismatched disk entries are MISSES, never
+errors: the entry is deleted and the caller recompiles.  Disable the disk
+tier with ``PT_CACHE=0`` (the test suite does — cache-hit timing would
+make retrace-count assertions order-dependent).
+"""
+import hashlib
+import json
+import os
+import pickle
+import threading
+from collections import OrderedDict
+
+from .. import observability as _obs
+
+__all__ = ['launch_fingerprint', 'program_fingerprint', 'ExecutableLRU',
+           'DiskCache', 'disk_cache', 'cache_dir', 'disk_enabled',
+           'ensure_xla_cache_backstop']
+
+# bump when the on-disk payload layout changes: old entries become misses
+CACHE_FORMAT = 1
+
+_DEFAULT_DIR = os.path.join(os.path.expanduser('~'), '.cache', 'paddle_tpu')
+
+
+def disk_enabled():
+    return os.environ.get('PT_CACHE', '1') not in ('0', 'false', 'False')
+
+
+def cache_dir():
+    return os.environ.get('PT_CACHE_DIR', _DEFAULT_DIR)
+
+
+# ------------------------------------------------------------ fingerprints
+
+def program_fingerprint(program):
+    """Stable hash of the serialized ProgramDesc (+ AMP flag and sharding
+    annotations, which change the lowering without touching the desc).
+    Cached on the program keyed by its mutation counter, so the desc walk
+    runs once per edit, not once per launch."""
+    cached = getattr(program, '_pt_fingerprint', None)
+    if cached is not None and cached[0] == program._version:
+        return cached[1]
+    from .. import io as fluid_io
+    desc = fluid_io.program_to_desc(program)
+    desc['_amp'] = bool(getattr(program, '_amp', False))
+    desc['_sharding'] = {n: str(s) for n, s in
+                        sorted(getattr(program, '_sharding', {}).items())}
+    blob = json.dumps(desc, sort_keys=True, default=str)
+    fp = hashlib.sha256(blob.encode()).hexdigest()
+    program._pt_fingerprint = (program._version, fp)
+    return fp
+
+
+def _environment_blob():
+    """Everything outside the program that decides executable validity."""
+    import jax
+    import jaxlib
+    try:
+        dev0 = jax.devices()[0]
+        backend = (dev0.platform, str(dev0.device_kind), jax.device_count())
+    except Exception:  # noqa: BLE001 - no backend yet: still fingerprintable
+        backend = ('none', 'none', 0)
+    return {
+        'format': CACHE_FORMAT,
+        'jax': jax.__version__,
+        'jaxlib': jaxlib.__version__,
+        'backend': backend,
+        'x64': bool(jax.config.jax_enable_x64),
+        'amp_flow': os.environ.get('PT_AMP_FLOW', 'conv'),
+    }
+
+
+def _mesh_blob(mesh):
+    if mesh is None:
+        return None
+    return {'axes': [str(a) for a in mesh.axis_names],
+            'shape': list(mesh.devices.shape)}
+
+
+def launch_fingerprint(program, feed_specs, fetch_names, steps, check_nan,
+                       mesh=None, param_specs=None, extra=None):
+    """The canonical cache key: program + launch signature + environment.
+
+    feed_specs / param_specs: {name: (shape_tuple, dtype_str)}.  Param
+    specs come from the scope at lowering time — an executable compiled
+    for f32 params can never be handed bf16 ones (the AOT artifact has no
+    re-specialization path, unlike jit)."""
+    blob = {
+        'program': program_fingerprint(program),
+        'feeds': {n: [list(s), d] for n, (s, d) in sorted(feed_specs.items())},
+        'params': {n: [list(s), d] for n, (s, d) in
+                   sorted((param_specs or {}).items())},
+        'fetch': list(fetch_names),
+        'steps': steps,
+        'check_nan': bool(check_nan),
+        'mesh': _mesh_blob(mesh),
+        'env': _environment_blob(),
+        'extra': extra,
+    }
+    canon = json.dumps(blob, sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+# ------------------------------------------------------------ in-process L1
+
+class ExecutableLRU(object):
+    """Bounded insertion/access-ordered map for compiled-executable entries.
+
+    The seed executor's ``self._cache`` dict grew one entry per
+    (program, feeds, fetches, K, scope) forever; long-running services
+    compiling many programs leaked every executable they ever built.
+    Capacity comes from ``PT_EXEC_CACHE_MAX`` (default 64); each eviction
+    increments ``pt_exec_cache_evictions``."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            capacity = int(os.environ.get('PT_EXEC_CACHE_MAX', '64'))
+        self.capacity = max(1, int(capacity))
+        self._map = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            entry = self._map.get(key)
+            if entry is not None:
+                self._map.move_to_end(key)
+            return entry
+
+    def put(self, key, entry):
+        with self._lock:
+            self._map[key] = entry
+            self._map.move_to_end(key)
+            while len(self._map) > self.capacity:
+                self._map.popitem(last=False)
+                _obs.metrics.counter('pt_exec_cache_evictions').inc()
+
+    def __len__(self):
+        return len(self._map)
+
+    def __contains__(self, key):
+        return key in self._map
+
+    def clear(self):
+        with self._lock:
+            self._map.clear()
+
+
+# ------------------------------------------------------------ on-disk L2
+
+class DiskCache(object):
+    """Content-addressed executable store: ``<dir>/v<FMT>/<fp[:2]>/<fp>.pkl``.
+
+    Payloads are pickled dicts carrying either a serialized executable
+    (``tier='exec'``: the (bytes, in_tree, out_tree) triple from
+    ``serialize_executable.serialize``) or the lowered StableHLO text
+    (``tier='stablehlo'``).  Every load failure — unpickleable, truncated,
+    foreign format, deserialize error — deletes the entry and reports a
+    miss."""
+
+    def __init__(self, root=None):
+        self._root = root
+
+    @property
+    def root(self):
+        return self._root if self._root is not None else cache_dir()
+
+    def _path(self, fingerprint):
+        return os.path.join(self.root, 'v%d' % CACHE_FORMAT,
+                            fingerprint[:2], fingerprint + '.pkl')
+
+    def load(self, fingerprint):
+        """Returns (compiled_or_None, tier_or_None).  ``('…', 'exec')`` is
+        a full hit (trace AND compile skipped); ``(None, 'stablehlo')``
+        means only the HLO was cached — the caller retraces, with the XLA
+        backstop shortcutting the backend compile; ``(None, None)`` is a
+        miss."""
+        path = self._path(fingerprint)
+        try:
+            with open(path, 'rb') as f:
+                payload = pickle.load(f)
+        except FileNotFoundError:
+            return None, None
+        except Exception:  # noqa: BLE001 - corruption is a miss
+            self._drop(path, 'unreadable')
+            return None, None
+        try:
+            if (payload.get('format') != CACHE_FORMAT or
+                    payload.get('fingerprint') != fingerprint):
+                raise ValueError('format/fingerprint mismatch')
+            if payload['tier'] == 'exec':
+                from jax.experimental import serialize_executable as se
+                serialized, in_tree, out_tree = payload['payload']
+                compiled = se.deserialize_and_load(serialized, in_tree,
+                                                   out_tree)
+                _obs.metrics.counter('compile_cache.bytes_read').inc(
+                    os.path.getsize(path))
+                return compiled, 'exec'
+            if payload['tier'] == 'stablehlo':
+                return None, 'stablehlo'
+            raise ValueError('unknown tier %r' % (payload.get('tier'),))
+        except Exception:  # noqa: BLE001 - stale entries die quietly
+            self._drop(path, 'undeserializable')
+            return None, None
+
+    def store(self, fingerprint, compiled=None, lowered=None, meta=None):
+        """Serialize ``compiled`` (preferred) or fall back to the lowered
+        StableHLO.  Returns the tier written, or None when nothing could
+        be persisted.  Failures never propagate: persistence is an
+        optimization, not a correctness dependency."""
+        payload = None
+        if compiled is not None:
+            try:
+                from jax.experimental import serialize_executable as se
+                payload = {'tier': 'exec', 'payload': se.serialize(compiled)}
+            except Exception:  # noqa: BLE001 - backend can't serialize
+                payload = None
+        if payload is None and lowered is not None:
+            try:
+                payload = {'tier': 'stablehlo', 'payload': lowered.as_text()}
+            except Exception:  # noqa: BLE001
+                return None
+        if payload is None:
+            return None
+        payload['format'] = CACHE_FORMAT
+        payload['fingerprint'] = fingerprint
+        payload['meta'] = dict(meta or {}, env=_environment_blob())
+        path = self._path(fingerprint)
+        tmp = path + '.tmp.%d' % os.getpid()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, 'wb') as f:
+                pickle.dump(payload, f)
+            os.replace(tmp, path)  # atomic: concurrent readers never see torn
+            _obs.metrics.counter('compile_cache.disk_stores').inc()
+            _obs.metrics.counter('compile_cache.bytes_written').inc(
+                os.path.getsize(path))
+        except Exception:  # noqa: BLE001 - read-only/full disk: skip caching
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+        return payload['tier']
+
+    @staticmethod
+    def _drop(path, reason):
+        _obs.metrics.counter('compile_cache.corrupt_entries').inc()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
+_DISK = DiskCache()
+
+
+def disk_cache():
+    return _DISK
+
+
+# ------------------------------------------------------- XLA-level backstop
+
+_XLA_WIRED = [False]
+
+
+def ensure_xla_cache_backstop():
+    """Point jax's persistent compilation cache at ``$PT_CACHE_DIR/xla``.
+
+    This is the third tier: when only StableHLO could be cached (or a jit
+    fallback retraces), the retrace still happens in Python but XLA's
+    backend compile — the dominant cost — is served from disk.  A user
+    who already configured ``jax_compilation_cache_dir`` wins; we never
+    override."""
+    if _XLA_WIRED[0] or not disk_enabled():
+        return
+    _XLA_WIRED[0] = True
+    import jax
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return
+        jax.config.update('jax_compilation_cache_dir',
+                          os.path.join(cache_dir(), 'xla'))
+        jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                          float(os.environ.get('PT_CACHE_XLA_MIN_S', '0')))
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+    except Exception:  # noqa: BLE001 - older jaxlib without these knobs
+        pass

@@ -14,6 +14,7 @@ import time
 import numpy as np
 
 from . import registry
+from . import compile_cache as _cc
 from .framework import (Variable, Parameter, default_main_program, TPUPlace,
                         Program)
 from .. import observability as _obs
@@ -532,6 +533,38 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
     return jax.jit(run_fn, **jit_kwargs), params_in, writeback
 
 
+def _feed_spec(v):
+    """(shape, dtype-string) of one feed/param value — the unit both the
+    in-process hot key and the disk fingerprint are built from."""
+    return (tuple(np.shape(v)),
+            str(getattr(v, 'dtype', type(v).__name__)))
+
+
+class _ExecEntry(object):
+    """One resolved executable: `call` is the AOT-compiled artifact (from
+    an eager lower().compile() or deserialized from disk); `jit_fn` is the
+    lazily-specializing fallback kept for the rare input-spec drift an AOT
+    executable cannot absorb (e.g. a scope param swapped to a new dtype).
+    The strong `program` ref pins id(program) against recycling while the
+    entry lives."""
+    __slots__ = ('call', 'jit_fn', 'params_in', 'writeback', 'program',
+                 'fingerprint')
+
+    def __init__(self, call, jit_fn, params_in, writeback, program,
+                 fingerprint):
+        self.call = call
+        self.jit_fn = jit_fn
+        self.params_in = params_in
+        self.writeback = writeback
+        self.program = program
+        self.fingerprint = fingerprint
+
+
+def _tail_split_enabled():
+    return os.environ.get('PT_TAIL_SPLIT', '1') not in ('0', 'false',
+                                                        'False')
+
+
 class Executor(object):
     """Parity: reference executor.py Executor (run/close/feed/fetch API)."""
 
@@ -549,15 +582,24 @@ class Executor(object):
             check_nan = os.environ.get('FLAGS_check_nan_inf', '') in (
                 '1', 'true', 'True')
         self.check_nan = bool(check_nan)
-        self._cache = {}
+        # L1 of the two-tier compilation cache (core/compile_cache.py):
+        # fingerprinted executables, LRU-bounded by PT_EXEC_CACHE_MAX —
+        # the seed's dict grew one executable per signature forever
+        self._cache = _cc.ExecutableLRU()
         self._run_counter = {}
         self._shard_targets = {}
+        # largest K ever launched per (program, fetch set): a smaller K
+        # against the same program is a ragged tail, and run_steps routes
+        # it through the single-step executable instead of lowering a
+        # whole new scan (PT_TAIL_SPLIT=0 restores per-tail lowering)
+        self._steps_seen = {}
         # telemetry span tags (ParallelExecutor sets mesh/shard info here)
         self._obs_tags = {}
 
     def close(self):
         self._cache.clear()
         self._shard_targets.clear()
+        self._steps_seen.clear()
 
     def _resolve_fetch(self, fetch_list):
         names = []
@@ -668,43 +710,58 @@ class Executor(object):
                     raise ValueError('per-step feeds disagree on keys: '
                                      '%s vs %s' % (sorted(names), sorted(f)))
             feed_vals = _stack_feeds(per_step)
+        steps = int(steps)
+        fetch_names = tuple(self._resolve_fetch(fetch_list))
+        seen_key = (id(program), program._version, fetch_names)
+        kmax = self._steps_seen.get(seen_key, 0)
+        if (use_program_cache and _tail_split_enabled() and steps < kmax
+                and self._hot_key(program, feed_vals, fetch_names, steps)
+                not in self._cache):
+            # ragged tail: a K smaller than this program has already
+            # launched, with no executable for it.  Lowering a steps=K'
+            # scan per distinct tail length is one full compile each;
+            # K' launches of the (reused-forever) single-step executable
+            # consume the same RNG counters and are bitwise identical.
+            return self._run_tail_split(program, feed_vals, fetch_list,
+                                        steps, scope, return_numpy)
+        self._steps_seen[seen_key] = max(kmax, steps)
         return self._run_impl(program, feed_vals, fetch_list, scope,
                               return_numpy, use_program_cache,
-                              steps=int(steps))
+                              steps=steps)
 
-    def _run_impl(self, program, feed_vals, fetch_list, scope,
-                  return_numpy, use_program_cache, steps):
+    def _run_tail_split(self, program, feed_vals, fetch_list, steps, scope,
+                        return_numpy):
+        """Run a ragged-tail superbatch as `steps` single-step launches.
+        Output shape contract matches the fused path: fetches stacked on a
+        leading [steps] axis."""
+        if _obs.enabled():
+            _obs.metrics.counter('executor.tail_splits').inc()
+            _obs.instant('executor.tail_split', cat='compile',
+                         args={'steps': steps})
+        outs = [self._run_impl(program,
+                               {k: v[i] for k, v in feed_vals.items()},
+                               fetch_list, scope, False, True, steps=None)
+                for i in range(steps)]
+        if return_numpy:
+            return [np.stack([np.asarray(o[j]) for o in outs])
+                    for j in range(len(outs[0]))]
+        import jax.numpy as jnp
+        return [jnp.stack([o[j] for o in outs])
+                for j in range(len(outs[0]))]
+
+    def _hot_key(self, program, feed_vals, fetch_names, steps):
+        """In-process (L1) cache key.  Unlike the seed's key it includes
+        feed shapes/dtypes — an entry holds one AOT-compiled executable,
+        which (by design) has no lazy re-specialization to hide behind —
+        and excludes the scope: the executable is scope-agnostic, state
+        flows through its arguments."""
+        return (id(program), program._version,
+                tuple((n,) + _feed_spec(feed_vals[n])
+                      for n in sorted(feed_vals)),
+                fetch_names, self.check_nan, steps)
+
+    def _gather_params(self, program, params_in, scope, base_key):
         import jax
-        feed_names = tuple(sorted(feed_vals.keys()))
-        fetch_names = tuple(self._resolve_fetch(fetch_list))
-
-        # telemetry: ONE flag check per launch; when off, the hot path
-        # below does no telemetry work (no spans, no counters, no dicts)
-        obs_on = _obs.enabled()
-        if obs_on:
-            _obs.on_launch_start(self, time.perf_counter())
-
-        base_key = (id(program), program._version, feed_names, fetch_names,
-                    scope._serial)
-        key = base_key + (self.check_nan, steps)
-        entry = self._cache.get(key) if use_program_cache else None
-        if entry is None:
-            # the cached tuple keeps a strong ref to `program` so its id()
-            # (part of the key) can never be recycled by a new Program
-            t_l0 = time.perf_counter() if obs_on else None
-            entry = _lower(program, feed_names, fetch_names,
-                           donate=True, mesh=self.mesh,
-                           check_nan=self.check_nan, steps=steps) + (program,)
-            if use_program_cache:
-                self._cache[key] = entry
-            if obs_on:
-                _obs.metrics.counter('executor.lowerings').inc()
-                _obs.tracing.add_span(
-                    'executor.lower', t_l0, time.perf_counter(),
-                    cat='compile',
-                    args=dict(self._obs_tags, steps=steps) or None)
-        fn, params_in, writeback = entry[:3]
-
         params = {}
         for n in params_in:
             if n not in scope:
@@ -728,26 +785,187 @@ class Executor(object):
             params = {n: (v if getattr(v, 'sharding', None) == targets[n]
                           else jax.device_put(v, targets[n]))
                       for n, v in params.items()}
+        return params
 
-        # the rng stream is keyed WITHOUT check_nan or steps: toggling the
+    def _resolve_entry(self, program, feed_vals, feed_names, fetch_names,
+                       scope, steps, base_key, counter, use_cache, obs_on):
+        """Two-tier executable resolution (see core/compile_cache.py):
+        L1 in-process LRU by hot key; on miss, the canonical fingerprint
+        is tried against the disk store (a hit skips trace AND compile);
+        on a disk miss the program is traced and AOT-compiled eagerly
+        (`jit(fn).lower(...).compile()`) and the executable serialized
+        back to disk for the next process."""
+        hot_key = (self._hot_key(program, feed_vals, fetch_names, steps)
+                   if use_cache else None)
+        if use_cache:
+            entry = self._cache.get(hot_key)
+            if entry is not None:
+                return entry, self._gather_params(program, entry.params_in,
+                                                  scope, base_key)
+        t_l0 = time.perf_counter() if obs_on else None
+        jit_fn, params_in, writeback = _lower(
+            program, feed_names, fetch_names, donate=True, mesh=self.mesh,
+            check_nan=self.check_nan, steps=steps)
+        if obs_on:
+            _obs.metrics.counter('executor.lowerings').inc()
+            _obs.tracing.add_span(
+                'executor.lower', t_l0, time.perf_counter(), cat='compile',
+                args=dict(self._obs_tags, steps=steps) or None)
+        params = self._gather_params(program, params_in, scope, base_key)
+        if not use_cache:
+            # cache bypass keeps the seed semantics: a lazily-retracing
+            # jit call per run, observed by the explainer at call time
+            return (_ExecEntry(jit_fn, jit_fn, params_in, writeback,
+                               program, None), params)
+
+        call, fp, disk_tier = None, None, None
+        if _cc.disk_enabled():
+            _cc.ensure_xla_cache_backstop()
+            fp = _cc.launch_fingerprint(
+                program, {n: _feed_spec(feed_vals[n]) for n in feed_names},
+                fetch_names, steps, self.check_nan, mesh=self.mesh,
+                param_specs={n: _feed_spec(v) for n, v in params.items()})
+            t_a0 = time.perf_counter()
+            call, disk_tier = _cc.disk_cache().load(fp)
+            if obs_on:
+                t_a1 = time.perf_counter()
+                if call is not None:
+                    _obs.metrics.counter('compile_cache.disk_hits').inc()
+                    _obs.metrics.counter('compile_cache.load_s').inc(
+                        t_a1 - t_a0)
+                    _obs.tracing.add_span(
+                        'executor.aot_load', t_a0, t_a1, cat='compile',
+                        args=dict(self._obs_tags, steps=steps) or None)
+                    sig = _launch_signature(program, feed_vals, feed_names,
+                                            fetch_names, steps,
+                                            self.check_nan, scope)
+                    _obs.explainer().observe_disk_load(
+                        sig, load_s=t_a1 - t_a0)
+                else:
+                    _obs.metrics.counter('compile_cache.disk_misses').inc()
+        if call is None:
+            tc0 = _TRACE_COUNT[0]
+            t_c0 = time.perf_counter()
+            lowered = jit_fn.lower(params,
+                                   {n: feed_vals[n] for n in feed_names},
+                                   np.uint32(counter & 0xffffffff))
+            call = lowered.compile()
+            t_c1 = time.perf_counter()
+            if obs_on and _TRACE_COUNT[0] > tc0:
+                sig = _launch_signature(program, feed_vals, feed_names,
+                                        fetch_names, steps, self.check_nan,
+                                        scope)
+                cache_status = ('disabled' if fp is None else
+                                'stablehlo_hit' if disk_tier == 'stablehlo'
+                                else 'miss')
+                report = _obs.explainer().observe(
+                    sig, compile_s=t_c1 - t_c0, cache=cache_status)
+                _obs.tracing.add_span(
+                    'executor.trace_compile', t_c0, t_c1, cat='compile',
+                    args=dict(self._obs_tags, steps=steps,
+                              kind=report['kind'],
+                              cause='; '.join(report['details'])[:512]
+                              or None))
+            if fp is not None:
+                t_s0 = time.perf_counter()
+                tier = _cc.disk_cache().store(
+                    fp, compiled=call, lowered=lowered,
+                    meta={'steps': steps, 'fetch': list(fetch_names),
+                          'program': _cc.program_fingerprint(program)})
+                if tier and obs_on:
+                    _obs.metrics.counter('compile_cache.store_s').inc(
+                        time.perf_counter() - t_s0)
+        entry = _ExecEntry(call, jit_fn, params_in, writeback, program, fp)
+        self._cache.put(hot_key, entry)
+        return entry, params
+
+    def prepare(self, program=None, feed=None, fetch_list=None, scope=None,
+                steps=None):
+        """AOT pre-warm: resolve — load from disk, or trace+compile and
+        persist — the executable for the given feed signature WITHOUT
+        running a step.  `feed` maps name -> example array or a
+        ``(shape, dtype)`` spec (zeros are synthesized); ``steps=K``
+        pre-warms the fused K-step scan (the example feeds are stacked
+        internally).  The scope must already hold initialized persistables
+        (run the startup program first).  Returns the entry's disk
+        fingerprint, or None when the disk tier is disabled."""
+        if program is None:
+            program = default_main_program()
+        scope = scope if scope is not None else global_scope()
+        example = {}
+        for k, v in (feed or {}).items():
+            if isinstance(v, tuple) and len(v) == 2 and \
+                    not hasattr(v, 'dtype'):
+                from .dtypes import convert_dtype
+                shape, dtype = v
+                v = np.zeros(tuple(int(d) for d in shape),
+                             convert_dtype(dtype))
+            example[k] = v
+        feed_vals = self._normalize_feed(program.global_block(), example)
+        if steps is not None:
+            steps = int(steps)
+            feed_vals = _stack_feeds([feed_vals] * steps)
+        feed_names = tuple(sorted(feed_vals.keys()))
+        fetch_names = tuple(self._resolve_fetch(fetch_list))
+        base_key = (id(program), program._version, feed_names, fetch_names,
+                    scope._serial)
+        entry, _ = self._resolve_entry(
+            program, feed_vals, feed_names, fetch_names, scope, steps,
+            base_key, 0, True, _obs.enabled())
+        if steps is not None:
+            seen_key = (id(program), program._version, fetch_names)
+            self._steps_seen[seen_key] = max(
+                self._steps_seen.get(seen_key, 0), steps)
+        return entry.fingerprint
+
+    def _run_impl(self, program, feed_vals, fetch_list, scope,
+                  return_numpy, use_program_cache, steps):
+        feed_names = tuple(sorted(feed_vals.keys()))
+        fetch_names = tuple(self._resolve_fetch(fetch_list))
+
+        # telemetry: ONE flag check per launch; when off, the hot path
+        # below does no telemetry work (no spans, no counters, no dicts)
+        obs_on = _obs.enabled()
+        if obs_on:
+            _obs.on_launch_start(self, time.perf_counter())
+
+        # rng/shard-layout bookkeeping stays scope-local (unlike the
+        # executable): parallel scopes keep independent RNG streams.
+        # The stream is keyed WITHOUT check_nan or steps: toggling the
         # debug flag mid-training does not restart dropout masks, and a
         # K-step launch consumes the same K counters that K sequential
         # runs would — mixed run/run_steps usage shares one stream
+        base_key = (id(program), program._version, feed_names, fetch_names,
+                    scope._serial)
         counter = self._run_counter.get(base_key, 0)
         self._run_counter[base_key] = counter + (steps or 1)
+
+        entry, params = self._resolve_entry(
+            program, feed_vals, feed_names, fetch_names, scope, steps,
+            base_key, counter, use_program_cache, obs_on)
 
         if obs_on:
             tc0 = _TRACE_COUNT[0]
             t_d0 = time.perf_counter()
-        result = fn(params,
-                    {n: feed_vals[n] for n in feed_names},
-                    np.uint32(counter & 0xffffffff))
+        feeds = {n: feed_vals[n] for n in feed_names}
+        ctr = np.uint32(counter & 0xffffffff)
+        try:
+            result = entry.call(params, feeds, ctr)
+        except TypeError:
+            # an input spec drifted under an AOT executable (scope param
+            # swapped to a new dtype/sharding): the artifact cannot
+            # re-specialize, so drop this entry to the lazily-retracing
+            # jit fallback — the explainer names the retrace below
+            if entry.call is entry.jit_fn:
+                raise
+            entry.call = entry.jit_fn
+            result = entry.call(params, feeds, ctr)
         if obs_on:
             t_d1 = time.perf_counter()
             _obs.metrics.counter('executor.launches').inc()
             if _TRACE_COUNT[0] > tc0:
-                # this launch (re)traced+compiled: build the structured
-                # signature and let the explainer name what changed
+                # only the jit-fallback / cache-bypass paths trace at call
+                # time; cached-path traces happen inside _resolve_entry
                 sig = _launch_signature(program, feed_vals, feed_names,
                                         fetch_names, steps, self.check_nan,
                                         scope)

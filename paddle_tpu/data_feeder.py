@@ -7,7 +7,16 @@ FeedPrefetcher is the host side of the multi-step execution path
 (Executor.run_steps): a bounded background queue that stacks K per-step
 feed dicts into one [K, ...] superbatch and device_puts it while the
 device runs the current launch, so host->device transfer overlaps compute.
+
+FeedBucketer is the shape-stability half of the compilation-persistence
+story (core/compile_cache.py): variable batch/sequence sizes — ragged
+epoch tails, LoD sequence lengths — each lower a fresh executable under
+whole-block jit.  The bucketer pads the leading batch dim (and declared
+sequence dims) up to a small set of boundaries and threads a validity
+mask feed, so arbitrary feed streams collapse onto a handful of compile
+signatures instead of one trace per shape.
 """
+import os
 import queue
 import threading
 import time
@@ -19,7 +28,166 @@ from .core.lod import create_lod_tensor
 from .core.dtypes import convert_dtype
 from . import observability as _obs
 
-__all__ = ['DataFeeder', 'FeedPrefetcher']
+__all__ = ['DataFeeder', 'FeedPrefetcher', 'FeedBucketer']
+
+
+def _default_boundaries():
+    """Powers-of-two with 1.5x midpoints: dense enough that pad waste
+    stays under ~25%, sparse enough that a whole training run touches
+    only a few signatures.  Override per-instance or via PT_BUCKETS."""
+    env = os.environ.get('PT_BUCKETS')
+    if env:
+        return sorted(int(b) for b in env.replace(',', ' ').split())
+    bounds = [1, 2, 4, 6, 8]
+    while bounds[-1] < 65536:
+        b = bounds[-1]
+        # 8, 12, 16, 24, 32, 48, 64, 96, 128, ...
+        bounds.append(b + b // 2 if (b & (b - 1)) == 0 else b + b // 3)
+    return bounds
+
+
+class FeedBucketer(object):
+    """Pad feeds up to bucket boundaries so variable shapes reuse a small
+    fixed set of executables.
+
+    * **Batch dim** (axis 0 of every feed whose leading dim matches the
+      batch): padded up to the smallest boundary >= the true batch by
+      edge-replicating the last row (every op stays well-defined on pad
+      rows; they carry no NaN/div-by-zero hazard).  When `mask_name` is
+      set, a float32 ``[B', 1]`` validity mask (1 real / 0 pad) is added
+      to the feed — thread it through loss/metric reductions
+      (``loss = sum(per_example * mask) / sum(mask)``) and padded rows
+      contribute exactly zero to the loss AND to every gradient.
+    * **Sequence dims**: feeds named in `seq_names` get axis 1 padded up
+      to a boundary with zeros.  LoDTensor feeds already carry true
+      lengths in their ``@LENGTH`` companion, and every sequence op masks
+      by length — so sequence-bucketed feeds need no extra mask.
+
+    Pad waste is observable: ``bucketer.rows_real`` / ``bucketer.rows_pad``
+    counters and the ``bucketer.pad_waste`` gauge (last batch's padded
+    fraction) land in the PR 2 metrics registry.
+
+    Compose with the prefetcher as ``FeedPrefetcher(feeds, bucketer=b)``
+    or wrap any feed iterable with :meth:`wrap`.
+    """
+
+    def __init__(self, boundaries=None, mask_name=None, seq_names=(),
+                 pad_mode='edge'):
+        self.boundaries = sorted(int(b) for b in
+                                 (boundaries or _default_boundaries()))
+        if not self.boundaries or self.boundaries[0] < 1:
+            raise ValueError('bucket boundaries must be positive ints')
+        self.mask_name = mask_name
+        self.seq_names = tuple(seq_names)
+        if pad_mode not in ('edge', 'zero'):
+            raise ValueError("pad_mode must be 'edge' or 'zero'")
+        self.pad_mode = pad_mode
+
+    def boundary(self, n):
+        """Smallest boundary >= n; beyond the largest boundary, the next
+        multiple of it (so huge batches still quantize, coarsely)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError('bucket size must be >= 1, got %d' % n)
+        for b in self.boundaries:
+            if b >= n:
+                return b
+        top = self.boundaries[-1]
+        return ((n + top - 1) // top) * top
+
+    def _pad_axis(self, arr, axis, target):
+        arr = np.asarray(arr)
+        gap = target - arr.shape[axis]
+        if gap <= 0:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[axis] = (0, gap)
+        if self.pad_mode == 'edge' and axis == 0 and arr.shape[0] > 0:
+            return np.pad(arr, widths, mode='edge')
+        return np.pad(arr, widths, mode='constant')
+
+    def bucket_feed(self, feed):
+        """One feed dict -> (padded feed dict, true batch size).  The mask
+        feed (if configured) is ALWAYS present — a full batch gets all
+        ones — so the feed-name set, which is part of the compile
+        signature, never wobbles between padded and exact batches."""
+        from .core.lod import LoDTensor
+        arrays = {k: (v if isinstance(v, LoDTensor) else np.asarray(v))
+                  for k, v in feed.items()}
+        # consensus batch: the leading dim of the first batched feed;
+        # arrays with a different leading dim pass through unpadded
+        dims = [d for d in (_leading_dim(v) for v in arrays.values())
+                if d is not None]
+        if not dims:
+            raise ValueError('bucket_feed needs at least one batched feed')
+        batch = dims[0]
+        target = self.boundary(batch)
+        out = {}
+        for k, v in arrays.items():
+            if isinstance(v, LoDTensor):
+                if v.outer_lengths is not None:
+                    # nested LoD: the inner-row dim is not the batch —
+                    # padding it would break the outer offset table
+                    out[k] = v
+                    continue
+                padded, lengths = v.padded, v.lengths
+                if padded.shape[0] == batch:
+                    padded = self._pad_axis(padded, 0, target)
+                    # edge-replicated lengths keep pad rows non-empty:
+                    # a zero-length row would NaN length-normalizing
+                    # sequence ops, and NaN * mask 0 is still NaN
+                    lengths = self._pad_axis(lengths, 0, target)
+                if k in self.seq_names:
+                    padded = self._pad_axis(padded, 1,
+                                            self.boundary(padded.shape[1]))
+                out[k] = LoDTensor(padded, lengths)
+                continue
+            if v.ndim and v.shape[0] == batch:
+                v = self._pad_axis(v, 0, target)
+            if k in self.seq_names and v.ndim >= 2:
+                v = self._pad_axis(v, 1, self.boundary(v.shape[1]))
+            out[k] = v
+        if self.mask_name:
+            mask = np.zeros((target, 1), np.float32)
+            mask[:batch] = 1.0
+            out[self.mask_name] = mask
+        if _obs.enabled():
+            _obs.metrics.counter('bucketer.batches').inc()
+            _obs.metrics.counter('bucketer.rows_real').inc(batch)
+            _obs.metrics.counter('bucketer.rows_pad').inc(target - batch)
+            _obs.metrics.gauge('bucketer.pad_waste').set(
+                (target - batch) / float(target))
+        return out, batch
+
+    def wrap(self, feeds):
+        """Generator over an iterable of feed dicts, bucketing each.
+        Yields just the padded feeds (the mask feed carries validity), so
+        the result plugs straight into FeedPrefetcher / run_steps."""
+        for f in feeds:
+            yield self.bucket_feed(f)[0]
+
+    @staticmethod
+    def trim(fetches, batch):
+        """Slice per-example fetch arrays back to the true batch size.
+        Arrays whose leading dim is not the padded batch (scalar losses,
+        stacked [K, B, ...] fetches get their SECOND dim trimmed) pass
+        through untouched where no dim matches."""
+        out = []
+        for f in fetches:
+            a = np.asarray(f)
+            if a.ndim >= 1 and a.shape[0] >= batch:
+                out.append(a[:batch])
+            else:
+                out.append(a)
+        return out
+
+
+def _leading_dim(v):
+    from .core.lod import LoDTensor
+    if isinstance(v, LoDTensor):
+        return v.padded.shape[0]
+    a = np.asarray(v)
+    return a.shape[0] if a.ndim else None
 
 
 class FeedPrefetcher(object):
@@ -41,12 +209,17 @@ class FeedPrefetcher(object):
                                    fetch_list=[loss])
     """
 
-    def __init__(self, feeds, steps=1, capacity=2, to_device=True):
+    def __init__(self, feeds, steps=1, capacity=2, to_device=True,
+                 bucketer=None):
         if steps < 1:
             raise ValueError('steps must be >= 1, got %r' % (steps,))
         if capacity < 1:
             raise ValueError('capacity must be >= 1, got %r' % (capacity,))
-        self._src = iter(feeds)
+        # bucketing happens on the worker thread, before stacking: padded
+        # per-step feeds share one shape, so a ragged epoch tail batch no
+        # longer breaks np.stack — nor costs a fresh compile signature
+        self._src = iter(bucketer.wrap(feeds) if bucketer is not None
+                         else feeds)
         self._steps = int(steps)
         self._to_device = to_device
         self._q = queue.Queue(maxsize=int(capacity))

@@ -13,7 +13,9 @@ import os
 import numpy as np
 
 from . import io as fluid_io
-from .core.executor import Executor, Scope, _lower, scope_guard
+from . import observability as _obs
+from .core import compile_cache as _cc
+from .core.executor import Executor, Scope, _feed_spec, _lower, scope_guard
 
 __all__ = ['AnalysisConfig', 'Predictor', 'create_paddle_predictor',
            'export_serialized', 'load_serialized']
@@ -75,6 +77,11 @@ class Predictor(object):
         self._fn, self._params_in, _ = _lower(
             self._program, tuple(self._feed_names),
             tuple(self._fetch_names), donate=False)
+        # per-shape AOT executables, warm-started from the persistent
+        # cache (core/compile_cache.py) when PT_CACHE is on: a freshly
+        # started serving process skips trace AND compile for every feed
+        # shape it has ever seen on this machine
+        self._compiled = {}
 
     def _cast_params_bf16(self):
         import jax.numpy as jnp
@@ -89,7 +96,30 @@ class Predictor(object):
         return list(self._fetch_names)
 
     def _fn_for(self, feeds):
-        return self._fn, self._params_in
+        if not _cc.disk_enabled():
+            return self._fn, self._params_in
+        shape_key = tuple((n,) + _feed_spec(feeds[n]) for n in sorted(feeds))
+        call = self._compiled.get(shape_key)
+        if call is not None:
+            return call, self._params_in
+        _cc.ensure_xla_cache_backstop()
+        params = {n: self._scope.vars[n] for n in self._params_in}
+        fp = _cc.launch_fingerprint(
+            self._program, {n: _feed_spec(v) for n, v in feeds.items()},
+            tuple(self._fetch_names), None, False,
+            param_specs={n: _feed_spec(v) for n, v in params.items()},
+            extra='predictor')
+        call, _tier = _cc.disk_cache().load(fp)
+        if call is None:
+            _obs.metrics.counter('compile_cache.disk_misses').inc()
+            lowered = self._fn.lower(params, dict(feeds), np.uint32(0))
+            call = lowered.compile()
+            _cc.disk_cache().store(fp, compiled=call, lowered=lowered,
+                                   meta={'kind': 'predictor'})
+        else:
+            _obs.metrics.counter('compile_cache.disk_hits').inc()
+        self._compiled[shape_key] = call
+        return call, self._params_in
 
     def run(self, feeds):
         """feeds: dict name->array, or list of arrays in input-name order.
@@ -125,7 +155,9 @@ def export_serialized(predictor, example_feeds, path):
     if isinstance(example_feeds, (list, tuple)):
         example_feeds = dict(zip(predictor._feed_names, example_feeds))
     example_feeds = {n: jnp.asarray(v) for n, v in example_feeds.items()}
-    fn, params_in = predictor._fn_for(example_feeds)
+    # export must trace, so it uses the jit fn — an AOT Compiled from
+    # _fn_for cannot be called with tracers
+    fn, params_in = predictor._fn, predictor._params_in
     params = {n: predictor._scope.vars[n] for n in params_in}
 
     def infer(params, feeds):

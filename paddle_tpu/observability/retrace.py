@@ -84,14 +84,36 @@ class LaunchSignature(object):
         return details
 
 
+def _bucketable(sig, prior):
+    """True when every differing feed shape differs only in its leading
+    (batch) and/or second (sequence) dim — the exact raggedness
+    FeedBucketer pads away."""
+    names = set(sig.feed_shapes) | set(prior.feed_shapes)
+    saw_diff = False
+    for n in names:
+        a = sig.feed_shapes.get(n)
+        b = prior.feed_shapes.get(n)
+        if a == b:
+            continue
+        if a is None or b is None or len(a) != len(b):
+            return False
+        if any(x != y for x, y in zip(a[2:], b[2:])):
+            return False
+        saw_diff = True
+    return saw_diff
+
+
 class RetraceExplainer(object):
     def __init__(self, max_reports=1000):
         self._lock = threading.Lock()
         self._seen = []
         self.reports = deque(maxlen=max_reports)
 
-    def observe(self, sig, compile_s=0.0, label=None):
-        """Record one (re)trace; returns the report dict."""
+    def observe(self, sig, compile_s=0.0, label=None, cache=None):
+        """Record one (re)trace; returns the report dict.  `cache` names
+        the disk-cache verdict for this trace ('miss' / 'stablehlo_hit' /
+        'disabled') so every retrace is annotated with whether the
+        persistent tier could have prevented it."""
         with self._lock:
             if not self._seen:
                 kind, changed, details = 'initial_compile', [], []
@@ -110,9 +132,16 @@ class RetraceExplainer(object):
                     kind = 'retrace'
                     details = ['identical signature retraced (cache '
                                'bypassed or jit cache evicted)']
+            if kind == 'retrace' and changed and \
+                    set(changed) <= {'feed_shapes'} and \
+                    _bucketable(sig, nearest):
+                details.append(
+                    'bucketable: shapes differ only in batch/sequence '
+                    'dims — a FeedBucketer (data_feeder.py) would map '
+                    'this feed onto an existing bucket signature')
             self._seen.append(sig)
         report = {'kind': kind, 'changed': changed, 'details': details,
-                  'compile_s': compile_s, 'label': label}
+                  'compile_s': compile_s, 'label': label, 'cache': cache}
         self.reports.append(report)
         if kind == 'retrace':
             metrics.counter('executor.retraces').inc()
@@ -121,6 +150,19 @@ class RetraceExplainer(object):
         else:
             metrics.counter('executor.compiles').inc()
         metrics.counter('executor.compile_s').inc(compile_s)
+        return report
+
+    def observe_disk_load(self, sig, load_s=0.0):
+        """Record a warm start: this signature's executable came from the
+        persistent cache, so NO trace/compile happened — the signature
+        still joins the nearest-prior pool so later real retraces diff
+        against it."""
+        with self._lock:
+            self._seen.append(sig)
+        report = {'kind': 'disk_load', 'changed': [], 'details': [],
+                  'compile_s': 0.0, 'load_s': load_s, 'label': None,
+                  'cache': 'hit'}
+        self.reports.append(report)
         return report
 
     def last_report(self):
@@ -132,8 +174,10 @@ class RetraceExplainer(object):
         report = report or self.last_report()
         if report is None:
             return '<no traces recorded>'
-        lines = ['[%s] compile_s=%.3f%s'
+        lines = ['[%s] compile_s=%.3f%s%s'
                  % (report['kind'], report['compile_s'],
+                    ' cache=%s' % report['cache']
+                    if report.get('cache') else '',
                     ' label=%s' % report['label'] if report['label']
                     else '')]
         for d in report['details']:

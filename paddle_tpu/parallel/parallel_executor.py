@@ -48,6 +48,16 @@ class ParallelExecutor(object):
                              fetch_list=list(fetch_list),
                              scope=self._scope, return_numpy=return_numpy)
 
+    def prepare(self, program=None, feed=None, fetch_list=None, scope=None,
+                steps=None):
+        """AOT pre-warm over the device mesh: compile (or load from the
+        persistent cache) the mesh-sharded executable for this feed
+        signature without running a step.  The fingerprint includes the
+        mesh layout, so single-chip and mesh artifacts never collide."""
+        return self._exe.prepare(program or self._main_program, feed=feed,
+                                 fetch_list=list(fetch_list or []),
+                                 scope=self._scope, steps=steps)
+
     def run_steps(self, program=None, feed_list=None, fetch_list=None,
                   steps=None, return_numpy=True, **kwargs):
         """K iterations per launch over the device mesh: the same jitted

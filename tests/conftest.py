@@ -3,6 +3,10 @@ so multi-chip sharding paths are exercised without TPU hardware."""
 import os
 
 os.environ['JAX_PLATFORMS'] = 'cpu'  # override axon/tpu from the outer env
+# cold caches by default: trace-count and retrace-explainer assertions
+# depend on every signature actually compiling; warm-start tests opt back
+# in with an explicit PT_CACHE_DIR (see tests/test_compile_cache.py)
+os.environ.setdefault('PT_CACHE', '0')
 flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (

@@ -14,18 +14,28 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 t1_rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
 
-echo "== ci_smoke: bench.py JSON schema =="
-# tiny shapes: the smoke validates the schema, not the throughput
-bench_out=$(timeout -k 10 1200 env JAX_PLATFORMS=cpu BENCH_PROBE_TIMEOUT=60 \
-    BENCH_B=2 BENCH_T=16 BENCH_RESNET_B=1 BENCH_STEPS_PER_LAUNCH=2 \
-    python bench.py) || { echo "ci_smoke: bench.py FAILED"; exit 1; }
+echo "== ci_smoke: bench.py JSON schema + warm-start =="
+# tiny shapes: the smoke validates the schema, not the throughput.
+# Two runs over one fresh PT_CACHE_DIR: the first is cold and populates
+# the persistent compile cache, the second must WARM-START — disk cache
+# hits > 0 and compile seconds collapsing (core/compile_cache.py).
+smoke_cache=$(mktemp -d /tmp/pt_smoke_cache.XXXXXX)
+trap 'rm -rf "$smoke_cache"' EXIT
+bench_env="JAX_PLATFORMS=cpu BENCH_PROBE_TIMEOUT=60 BENCH_B=2 BENCH_T=16 \
+    BENCH_RESNET_B=1 BENCH_STEPS_PER_LAUNCH=2 PT_CACHE=1 PT_CACHE_DIR=$smoke_cache"
+bench_out=$(timeout -k 10 1200 env $bench_env python bench.py) \
+    || { echo "ci_smoke: bench.py (cold) FAILED"; exit 1; }
 echo "$bench_out"
+bench_out2=$(timeout -k 10 1200 env $bench_env python bench.py) \
+    || { echo "ci_smoke: bench.py (warm) FAILED"; exit 1; }
+echo "$bench_out2"
 
-python - "$bench_out" <<'EOF'
+python - "$bench_out" "$bench_out2" <<'EOF'
 import json
 import sys
 
 rec = json.loads(sys.argv[1].strip().splitlines()[-1])
+rec2 = json.loads(sys.argv[2].strip().splitlines()[-1])
 expected = [
     'metric', 'value', 'unit', 'vs_baseline', 'mfu', 'model_tflops_per_s',
     'params_m', 'matmul_params_m', 'backend', 'batch', 'seq', 'amp',
@@ -44,25 +54,47 @@ if not (isinstance(rec['value'], (int, float)) and rec['value'] > 0):
 
 tel = rec['telemetry']
 tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
-                'compiles', 'compile_s', 'stall_count',
-                'prefetch_starvation_s', 'fetch_sync_s']
+                'compiles', 'compile_s', 'compile_s_cold', 'compile_s_warm',
+                'compile_cache_hits', 'compile_cache_misses', 'tail_splits',
+                'stall_count', 'prefetch_starvation_s', 'fetch_sync_s']
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
     sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
 if not tel['platform']:
     sys.exit('ci_smoke: telemetry.platform is empty — the bench no longer '
              'self-labels the backend it ran on')
-if tel['retraces'] > 0:
-    sys.exit('ci_smoke: bench reports %d retrace(s) AFTER warmup — the '
-             'fused loop recompiled mid-measurement (retrace regression)'
-             % tel['retraces'])
+for label, t in (('cold', tel), ('warm', rec2['telemetry'])):
+    if t['retraces'] > 0:
+        sys.exit('ci_smoke: %s bench reports %d retrace(s) AFTER warmup — '
+                 'the fused loop recompiled mid-measurement (retrace '
+                 'regression)' % (label, t['retraces']))
 if tel['compiles'] < 1:
     sys.exit('ci_smoke: telemetry.compiles=%r — executor instrumentation '
              'recorded no compiles at all' % tel['compiles'])
+if tel['tail_splits'] < 1:
+    sys.exit('ci_smoke: tail_splits=%r — the ragged-tail superbatch did '
+             'not route through the single-step executable'
+             % tel['tail_splits'])
+
+# warm-start contract: second fresh process over the same PT_CACHE_DIR
+# serves executables from disk instead of compiling them
+tel2 = rec2['telemetry']
+if tel2['compile_cache_hits'] < 1:
+    sys.exit('ci_smoke: warm run reports compile_cache_hits=%r — the '
+             'persistent executable cache missed across processes'
+             % tel2['compile_cache_hits'])
+if not tel2['compile_s'] < 0.5 * max(tel['compile_s'], 1e-9):
+    sys.exit('ci_smoke: warm compile_s=%.3f did not drop vs cold=%.3f — '
+             'warm start is not actually skipping compilation'
+             % (tel2['compile_s'], tel['compile_s']))
 print('ci_smoke: bench JSON schema ok (%d keys, steps_per_launch=%d, '
       'platform=%s, retraces=%d after warmup)'
       % (len(rec), rec['steps_per_launch'], tel['platform'],
          tel['retraces']))
+print('ci_smoke: warm start ok (cold compile_s=%.2f -> warm %.2f, '
+      'hits=%d, load_s=%.2f)'
+      % (tel['compile_s'], tel2['compile_s'], tel2['compile_cache_hits'],
+         tel2['compile_s_warm']))
 EOF
 schema_rc=$?
 
