@@ -65,6 +65,7 @@ from .trainer import Trainer, BeginEpochEvent, EndEpochEvent, \
     BeginStepEvent, EndStepEvent, CheckpointConfig  # noqa
 from .inferencer import Inferencer  # noqa
 from . import annotations  # noqa
+from . import analysis  # noqa
 from . import net_drawer  # noqa
 from . import recordio_writer  # noqa
 from . import async_executor  # noqa

@@ -1,0 +1,89 @@
+"""paddle_tpu.analysis — static program analysis (the `pt-lint` engine).
+
+The reference framework runs C++ IR passes over ProgramDesc before
+execution (paddle/fluid/framework/ir/); this package is the TPU-native
+analog: multi-pass linting over the pure-Python Program IR, with a
+shape/dtype abstract interpreter (jax.eval_shape, no compilation) at its
+core.  Three entry points share one engine:
+
+  * ``Program.lint(...)``        — in-memory API (core/framework.py)
+  * ``tools/pt_lint.py``         — CLI over saved models & bundled models
+  * the executor's PT_LINT hook  — strict|warn|0 at lowering-cache miss
+                                   (core/executor.py _lower)
+
+See docs/analysis.md for the diagnostic code table (D001..D014) and
+severity semantics.
+"""
+import os
+import warnings
+
+from .diagnostics import Diagnostic, LintResult, LintError, CODES, SEVERITIES
+from .engine import lint_program, register_pass, pass_names, LintContext
+
+__all__ = ['Diagnostic', 'LintResult', 'LintError', 'CODES', 'SEVERITIES',
+           'lint_program', 'register_pass', 'pass_names', 'LintContext',
+           'lint_mode', 'apply_lint_policy', 'LintWarning']
+
+
+class LintWarning(UserWarning):
+    """Emitted (once per lint run) under PT_LINT=warn."""
+
+
+def lint_mode():
+    """Current executor lint policy from $PT_LINT: 'strict' (default),
+    'warn', or '0' (off — today's raw mid-trace failures)."""
+    mode = os.environ.get('PT_LINT', 'strict').strip().lower()
+    if mode in ('0', 'false', 'off', 'no'):
+        return '0'
+    if mode == 'warn':
+        return 'warn'
+    return 'strict'
+
+
+def apply_lint_policy(program, feed_names=(), fetch_names=(),
+                      bucketer=None, mode=None, header=None):
+    """Lint + enforce the PT_LINT policy; returns the LintResult.
+
+    strict: raise LintError (a ValueError) when error-severity findings
+            exist; warnings/infos are recorded silently.
+    warn:   one LintWarning summarizing everything at warning+.
+    0:      skip entirely (returns an empty result).
+
+    The result is stashed on ``program._last_lint`` and counted into the
+    observability registry (lint.findings / lint.errors) either way.
+    """
+    mode = lint_mode() if mode is None else mode
+    if mode == '0':
+        return LintResult()
+    # one lint per (program version, launch signature): run_steps tails
+    # and K-variants re-lower the same program — don't re-walk it
+    memo_key = (program._version, tuple(feed_names), tuple(fetch_names),
+                mode)
+    if getattr(program, '_lint_memo_key', None) == memo_key:
+        return program._last_lint
+    result = lint_program(program, feed_names=feed_names,
+                          fetch_names=fetch_names, bucketer=bucketer)
+    program._last_lint = result
+    from .. import observability as _obs
+    if _obs.enabled() and len(result):
+        _obs.metrics.counter('lint.findings').inc(len(result))
+        if result.errors:
+            _obs.metrics.counter('lint.errors').inc(len(result.errors))
+        if result.warnings:
+            _obs.metrics.counter('lint.warnings').inc(
+                len(result.warnings))
+    if mode == 'warn':
+        noteworthy = result.at_least('warning')
+        if noteworthy:
+            warnings.warn(LintWarning(
+                '%s:\n%s' % (header or 'program lint found issues',
+                             '\n'.join(d.render() for d in noteworthy))),
+                stacklevel=3)
+    elif result.has_errors():
+        raise LintError(result, header or 'program lint failed '
+                        '(PT_LINT=strict; set PT_LINT=warn or PT_LINT=0 '
+                        'to bypass)')
+    # memoize only the non-raising outcome: a strict failure must raise
+    # again on the next lowering attempt
+    program._lint_memo_key = memo_key
+    return result

@@ -1,0 +1,152 @@
+"""Lint engine: pass registry + shared program-walk context.
+
+Mirrors the reference's `framework/ir/pass.h` Pass/PassRegistry pair, but
+passes here are plain functions `fn(ctx) -> list[Diagnostic]` over the
+pure-Python Program IR (no C++ graph).  `lint_program` is the single
+entry point used by `Program.lint()`, the executor's PT_LINT hook, and
+tools/pt_lint.py.
+
+A crashing pass NEVER fails the lint run: the crash is downgraded to a
+D099 info diagnostic so analyzer bugs cannot block training (the
+executor hook depends on this).
+"""
+import traceback
+
+from .diagnostics import Diagnostic, LintResult
+
+__all__ = ['LintContext', 'register_pass', 'pass_names', 'lint_program']
+
+_PASSES = []  # [(name, fn)] in registration (= execution) order
+
+
+def register_pass(name):
+    def deco(fn):
+        _PASSES.append((name, fn))
+        return fn
+    return deco
+
+
+def pass_names():
+    _ensure_passes_loaded()
+    return [n for n, _ in _PASSES]
+
+
+_loaded = [False]
+
+
+def _ensure_passes_loaded():
+    if not _loaded[0]:
+        _loaded[0] = True
+        from . import passes  # noqa: F401  (self-registering modules)
+
+
+def _did_you_mean(name, candidates, n=1):
+    """Nearest candidate(s) by edit distance (difflib ratio)."""
+    import difflib
+    matches = difflib.get_close_matches(name, list(candidates), n=n,
+                                        cutoff=0.6)
+    return matches[0] if matches else None
+
+
+class LintContext(object):
+    """Everything a pass needs: the program plus precomputed walk maps."""
+
+    def __init__(self, program, feed_names=(), fetch_names=(),
+                 bucketer=None):
+        self.program = program
+        self.feed_names = tuple(feed_names)
+        self.fetch_names = tuple(fetch_names)
+        self.bucketer = bucketer
+        # block idx -> "block 0 > while@op12 > block 1" style path
+        self._block_paths = self._build_block_paths()
+        # block idx -> {var name -> (op_index, op)} LAST writer in block
+        self.producers = {}
+        # var name -> [(block_idx, op_index, op)] readers, program-wide
+        self.readers = {}
+        # var name -> number of writing ops program-wide: names written
+        # more than once are REBOUND (e.g. in-place grad clip) and their
+        # declared shape/dtype only reflects the last write
+        self.write_counts = {}
+        for b in program.blocks:
+            prod = {}
+            for i, op in enumerate(b.ops):
+                for n in op.input_names():
+                    self.readers.setdefault(n, []).append((b.idx, i, op))
+                for n in op.output_names():
+                    prod[n] = (i, op)
+                    self.write_counts[n] = self.write_counts.get(n, 0) + 1
+            self.producers[b.idx] = prod
+
+    def _build_block_paths(self):
+        paths = {0: 'block 0'}
+        # owning op of each sub-block: parent op carrying sub_block attr
+        for b in self.program.blocks:
+            for i, op in enumerate(b.ops):
+                sub = op.attrs.get('sub_block')
+                if sub is not None and sub not in paths:
+                    parent = paths.get(b.idx, 'block %d' % b.idx)
+                    paths[sub] = '%s > %s@op%d > block %d' % (
+                        parent, op.type, i, sub)
+        for b in self.program.blocks:
+            paths.setdefault(b.idx, 'block %d' % b.idx)
+        return paths
+
+    def block_path(self, block_idx):
+        return self._block_paths.get(block_idx, 'block %d' % block_idx)
+
+    def producer_of(self, block, name):
+        """Last op writing `name`, searched from `block` up the parent
+        chain (matches _find_var_recursive visibility)."""
+        b = block
+        while b is not None:
+            hit = self.producers[b.idx].get(name)
+            if hit is not None:
+                return hit[1]
+            b = b.parent
+        return None
+
+    def visible_names(self, block):
+        names = set()
+        b = block
+        while b is not None:
+            names.update(b.vars)
+            b = b.parent
+        return names
+
+    def suggest(self, name, candidates):
+        return _did_you_mean(name, candidates)
+
+    def diag(self, code, severity, message, block=None, op=None,
+             op_index=None, var=None, fixit=None, pass_name=None):
+        return Diagnostic(
+            code, severity, message, op=op, op_index=op_index,
+            block_idx=block.idx if block is not None else None,
+            block_path=(self.block_path(block.idx)
+                        if block is not None else None),
+            var=var, fixit=fixit, pass_name=pass_name)
+
+
+def lint_program(program, feed_names=(), fetch_names=(), bucketer=None,
+                 passes=None):
+    """Run the registered lint passes; returns a LintResult.
+
+    `passes` restricts to a subset of pass names (None = all).  Never
+    raises: pass crashes become D099 info diagnostics.  Strict-mode
+    raising is the caller's policy (see core.executor / Program.lint).
+    """
+    _ensure_passes_loaded()
+    ctx = LintContext(program, feed_names=feed_names,
+                      fetch_names=fetch_names, bucketer=bucketer)
+    result = LintResult()
+    for name, fn in _PASSES:
+        if passes is not None and name not in passes:
+            continue
+        try:
+            result.extend(fn(ctx) or ())
+        except Exception:
+            result.add(Diagnostic(
+                'D099', 'info',
+                'lint pass %r crashed: %s' % (
+                    name, traceback.format_exc(limit=3).strip()),
+                pass_name=name))
+    return result

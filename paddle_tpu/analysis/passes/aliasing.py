@@ -1,0 +1,92 @@
+"""D007/D008/D009: donation & aliasing conflicts.
+
+The executor donates the parameter dict to the lowered executable
+(donate_argnums) and, under run_steps, threads it as the lax.scan carry
+— so in-block aliasing patterns that are harmless in an op-by-op
+interpreter become real hazards here:
+
+  D007 warning  a Parameter is READ by an op after an earlier op in the
+                same block wrote it back: the reader sees the updated
+                value this step, and under a K-step scan the stale/fresh
+                split silently changes with K
+  D008 warning  a feed name shadows a parameter/persistable: the feed
+                wins, the scope value is ignored, and the writeback then
+                clobbers the scope entry
+  D009 warning  the same persistable is written by two ops in one block:
+                last-write-wins silently (the reference raises on this)
+"""
+from ...core.framework import Parameter
+from ..engine import register_pass
+
+__all__ = ['run']
+
+
+def _is_persistable(block, name):
+    v = block._find_var_recursive(name)
+    return v is not None and (v.persistable or isinstance(v, Parameter))
+
+
+def _is_parameter(block, name):
+    return isinstance(block._find_var_recursive(name), Parameter)
+
+
+@register_pass('aliasing')
+def run(ctx):
+    diags = []
+    program = ctx.program
+    root = program.global_block()
+
+    # ---- D008: feeds shadowing persistables --------------------------
+    for n in ctx.feed_names:
+        if _is_persistable(root, n):
+            kind = ('parameter' if _is_parameter(root, n)
+                    else 'persistable')
+            diags.append(ctx.diag(
+                'D008', 'warning',
+                'feed "%s" shadows a %s: the fed value replaces the '
+                'scope value for this launch, and any writeback then '
+                'overwrites the scope entry' % (n, kind),
+                block=root, var=n,
+                fixit='rename the feed, or drop the var from the feed '
+                      'list and assign it in the scope instead',
+                pass_name='aliasing'))
+
+    # ---- per-block write tracking for D007 / D009 --------------------
+    for block in program.blocks:
+        first_write = {}   # persistable name -> (op_index, op)
+        for i, op in enumerate(block.ops):
+            # D007: Parameter read after an in-block writeback.
+            # The same op reading AND writing a param (sgd's Param ->
+            # ParamOut) is the normal update idiom, not a hazard.
+            for n in op.input_names():
+                if n in first_write and first_write[n][0] < i and \
+                        _is_parameter(block, n):
+                    w_i, w_op = first_write[n]
+                    diags.append(ctx.diag(
+                        'D007', 'warning',
+                        'parameter "%s" is read by op "%s" after op#%d '
+                        '"%s" already wrote it back — the read sees the '
+                        'updated value; donated as a scan carry this '
+                        'read/writeback interleaving changes with '
+                        'steps=K' % (n, op.type, w_i, w_op.type),
+                        block=block, op=op, op_index=i, var=n,
+                        fixit='read the parameter before the update op, '
+                              'or snapshot it into a temporary first',
+                        pass_name='aliasing'))
+            for n in op.output_names():
+                if not _is_persistable(block, n):
+                    continue
+                if n in first_write and first_write[n][1] is not op:
+                    w_i, w_op = first_write[n]
+                    diags.append(ctx.diag(
+                        'D009', 'warning',
+                        'persistable "%s" is written by both op#%d "%s" '
+                        'and op#%d "%s" in one block — last write wins '
+                        'silently' % (n, w_i, w_op.type, i, op.type),
+                        block=block, op=op, op_index=i, var=n,
+                        fixit='drop one of the writes, or route the '
+                              'second through a fresh variable',
+                        pass_name='aliasing'))
+                else:
+                    first_write.setdefault(n, (i, op))
+    return diags

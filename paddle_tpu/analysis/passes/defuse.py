@@ -1,0 +1,79 @@
+"""D001: def-use ordering (folded in from core/validation.py).
+
+Same walk the executor has always run on lowering-cache miss, upgraded
+to structured diagnostics with the full block path and a did-you-mean
+suggestion (nearest visible var name by edit distance).  Unlike the old
+validate_def_use this reports EVERY violation, not just the first —
+core/validation.py keeps its first-error ValueError contract on top.
+"""
+from ...core.framework import Parameter
+from ..engine import register_pass
+
+__all__ = ['run', 'initially_defined']
+
+
+def initially_defined(program, feed_names):
+    defined = set(feed_names)
+    root = program.global_block()
+    for name, v in root.vars.items():
+        if isinstance(v, Parameter) or v.persistable or \
+                getattr(v, 'is_data', False):
+            defined.add(name)
+            if getattr(v, 'lod_level', 0) > 0:
+                defined.add(name + '@LENGTH')
+    return defined
+
+
+@register_pass('def_use')
+def run(ctx):
+    program = ctx.program
+    diags = []
+
+    def walk(block, defined):
+        for i, op in enumerate(block.ops):
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if n is None or n in defined:
+                        continue
+                    v = block._find_var_recursive(n)
+                    if v is not None and (isinstance(v, Parameter) or
+                                          v.persistable or
+                                          getattr(v, 'is_data', False) or
+                                          # arrays allocate on first
+                                          # write; the runtime raises its
+                                          # own read-before-write error
+                                          getattr(v, 'is_tensor_array',
+                                                  False)):
+                        defined.add(n)
+                        continue
+                    guess = ctx.suggest(n, defined | ctx.visible_names(block))
+                    diags.append(ctx.diag(
+                        'D001', 'error',
+                        'op "%s" reads var "%s" before any prior op, feed, '
+                        'parameter or persistable defines it. If this var '
+                        'is produced later in the program, reorder the '
+                        'ops; if it should be fed, add it to the feed '
+                        'list.' % (op.type, n),
+                        block=block, op=op, op_index=i, var=n,
+                        fixit=('did you mean "%s"?' % guess) if guess
+                        else None, pass_name='def_use'))
+                    # treat as defined from here on: one root cause, one
+                    # diagnostic — not a cascade per downstream reader
+                    defined.add(n)
+            sub = op.attrs.get('sub_block')
+            if sub is not None:
+                inner = set(defined)
+                if op.type == 'recurrent':
+                    inner |= set(op.attrs.get('step_vars', ()))
+                    inner |= set(op.attrs.get('mem_vars', ()))
+                # body-LOCAL temps do NOT survive the loop: the lowering
+                # writes back only carries (vars that pre-existed), so
+                # sub-block definitions are deliberately not merged — a
+                # later read of a body temp is itself a def-use violation
+                walk(program.block(sub), inner)
+            defined.update(n for n in op.output_names() if n)
+        return defined
+
+    walk(program.global_block(),
+         initially_defined(program, ctx.feed_names))
+    return diags

@@ -1,0 +1,109 @@
+"""D005/D006: dead ops and unused vars.
+
+Reverse liveness walk per block (the reference's C++ analog is the
+`graph_to_program` + dead-code-elimination IR passes): an op is alive
+when any output (transitively) reaches a fetch, a persistable write, a
+sub-block boundary, or a side-effecting op.  Everything else is work XLA
+would DCE anyway — but silently, so the user never learns their fetch
+list is wrong or a head was left unwired.
+
+The dead-op half needs a fetch set to anchor liveness; without one
+(e.g. linting a startup program) it is skipped and only the unused-var
+half runs.
+"""
+from ...core.framework import Parameter
+from ..engine import register_pass
+
+__all__ = ['run']
+
+# ops that are alive regardless of dataflow (observable effects)
+_SIDE_EFFECT_OPS = {'print', 'py_func', '__backward__', 'write_to_array'}
+
+
+def _sub_block_reads(program, block_idx, seen=None):
+    """All var names read anywhere inside a sub-block tree — control-flow
+    bodies read outer vars straight from the lowering env, not through
+    the owning op's input slots, so they count as escaping uses."""
+    seen = set() if seen is None else seen
+    if block_idx in seen:
+        return set()
+    seen.add(block_idx)
+    reads = set()
+    for op in program.block(block_idx).ops:
+        reads |= set(op.input_names())
+        reads |= set(op.attrs.get('params', ()))
+        sub = op.attrs.get('sub_block')
+        if sub is not None:
+            reads |= _sub_block_reads(program, sub, seen)
+    return reads
+
+
+def _block_liveness(ctx, block, fetch_names, diags):
+    program = ctx.program
+    persistable = set()
+    for b in program.blocks:
+        persistable |= {n for n, v in b.vars.items()
+                        if v.persistable or isinstance(v, Parameter)}
+    # names read by sub-blocks anywhere below an op of this block count
+    # as escaping uses (the sub-block boundary)
+    needed = set(fetch_names)
+    alive = [False] * len(block.ops)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        outs = set(op.output_names())
+        is_alive = (bool(outs & needed) or
+                    bool(outs & persistable) or
+                    op.type in _SIDE_EFFECT_OPS or
+                    op.attrs.get('sub_block') is not None)
+        if is_alive:
+            alive[i] = True
+            needed |= set(op.input_names())
+            if op.type == '__backward__':
+                needed |= set(op.attrs.get('params', ()))
+            sub = op.attrs.get('sub_block')
+            if sub is not None:
+                needed |= _sub_block_reads(program, sub)
+    for i, op in enumerate(block.ops):
+        if not alive[i]:
+            diags.append(ctx.diag(
+                'D005', 'warning',
+                'dead op "%s": its outputs %s never reach a fetch, '
+                'persistable, or sub-block boundary'
+                % (op.type, sorted(op.output_names())),
+                block=block, op=op, op_index=i,
+                fixit='remove the op, or add its output to fetch_list',
+                pass_name='liveness'))
+
+
+def _unused_vars(ctx, diags):
+    program = ctx.program
+    fetch = set(ctx.fetch_names)
+    for b in program.blocks:
+        for name, v in b.vars.items():
+            if '@' in name:
+                continue  # @GRAD / @LENGTH / @LR_DECAY_COUNTER@ plumbing
+            if v.persistable or isinstance(v, Parameter):
+                continue
+            if name in fetch or name in ctx.readers:
+                continue
+            produced = any(name in ctx.producers[bb.idx]
+                           for bb in program.blocks)
+            if not produced and not getattr(v, 'is_data', False):
+                continue  # declared-only scratch var: nothing to report
+            kind = 'feed var' if getattr(v, 'is_data', False) else 'var'
+            diags.append(ctx.diag(
+                'D006', 'info',
+                '%s "%s" is never read and never fetched' % (kind, name),
+                block=b, var=name,
+                fixit='drop it from the program or the feed list',
+                pass_name='liveness'))
+
+
+@register_pass('liveness')
+def run(ctx):
+    diags = []
+    if ctx.fetch_names:
+        _block_liveness(ctx, ctx.program.global_block(), ctx.fetch_names,
+                        diags)
+    _unused_vars(ctx, diags)
+    return diags

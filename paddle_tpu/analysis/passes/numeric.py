@@ -1,0 +1,256 @@
+"""D012/D013/D014: numerical hazards.
+
+Heuristic dataflow checks over producer chains (the linter analog of the
+runtime check_nan guard — check_nan tells you a step went non-finite,
+this pass points at the op that will make it go non-finite):
+
+  D012  log/div/exp over an input with no positivity/clipping guarantee
+  D013  softmax assembled by hand (exp -> reduce_sum -> div) without
+        subtracting the row max first — overflows in fp32 near x~88
+  D014  a learning-rate decay schedule whose constants cannot decay
+        (decay_rate >= 1 or <= 0, power <= 0, lr scaled by 0)
+"""
+from ..engine import register_pass
+
+__all__ = ['run']
+
+# producers whose output is strictly positive (safe under log / as a
+# divisor)
+_POSITIVE_PRODUCERS = {'exp', 'softplus'}
+# producers whose output is >= 0
+_NONNEG_PRODUCERS = {'exp', 'softplus', 'abs', 'square', 'relu',
+                     'sigmoid', 'softmax', 'sequence_softmax',
+                     'sequence_mask'}
+# log over these is a known anti-pattern with a fused replacement
+_LOG_OF = {'softmax': 'log_softmax', 'sequence_softmax': 'log_softmax',
+           'sigmoid': 'logsigmoid'}
+
+_DECAY_COUNTER_MARK = '_COUNTER@'
+
+
+def _const_value(op):
+    """fill_constant value, else None."""
+    if op is not None and op.type == 'fill_constant':
+        return op.attrs.get('value')
+    return None
+
+
+def _is_safe_positive(ctx, block, name, depth=3):
+    """Conservatively True when `name` is provably > 0 (heuristic,
+    bounded recursion)."""
+    if depth <= 0:
+        return False
+    op = ctx.producer_of(block, name)
+    if op is None:
+        return False
+    v = _const_value(op)
+    if v is not None:
+        try:
+            return float(v) > 0.0
+        except (TypeError, ValueError):
+            return False
+    if op.type in _POSITIVE_PRODUCERS:
+        return True
+    if op.type == 'clip':
+        try:
+            return float(op.attrs.get('min', 0.0)) > 0.0
+        except (TypeError, ValueError):
+            return False
+    if op.type == 'scale':
+        # scale*x + bias with scale >= 0, bias > 0 over a non-negative
+        # base stays positive; unknown bases get the benefit of the
+        # doubt — this is a linter, not a prover
+        try:
+            s = float(op.attrs.get('scale', 1.0))
+            b = float(op.attrs.get('bias', 0.0))
+        except (TypeError, ValueError):
+            return False
+        return s >= 0.0 and b > 0.0
+    if op.type in ('elementwise_add', 'elementwise_max'):
+        # x + p and max(x, p) are positive whenever either side is
+        # positive and the op can only move the result up (add assumes a
+        # non-negative other side — heuristic, see module docstring)
+        ins = op.input_names()
+        return any(_is_safe_positive(ctx, block, n, depth - 1)
+                   for n in ins)
+    return False
+
+
+def _is_guarded(ctx, block, name):
+    """True when `name` went through an explicit clip/guard."""
+    op = ctx.producer_of(block, name)
+    return op is not None and op.type in ({'clip', 'clip_by_norm'} |
+                                          _POSITIVE_PRODUCERS)
+
+
+def _softmax_pattern(ctx, block, exp_op, exp_idx):
+    """Detect exp -> reduce_sum -> elementwise_div over exp's output."""
+    outs = exp_op.output_names()
+    if not outs:
+        return False
+    exp_out = outs[0]
+    readers = [r for r in ctx.readers.get(exp_out, ())
+               if r[0] == block.idx]
+    sum_outs = {o for _, _, r_op in readers
+                if r_op.type in ('reduce_sum', 'sum')
+                for o in r_op.output_names()}
+    if not sum_outs:
+        return False
+    for _, _, r_op in readers:
+        if r_op.type == 'elementwise_div' and \
+                set(r_op.input('Y')) & sum_outs:
+            return True
+    return False
+
+
+def _has_max_subtraction(ctx, block, exp_op):
+    """exp's input produced by elementwise_sub whose Y is a reduce_max."""
+    ins = exp_op.input_names()
+    if not ins:
+        return False
+    prod = ctx.producer_of(block, ins[0])
+    if prod is None or prod.type != 'elementwise_sub':
+        return False
+    y = prod.input('Y')
+    if not y:
+        return False
+    y_prod = ctx.producer_of(block, y[0])
+    return y_prod is not None and y_prod.type == 'reduce_max'
+
+
+def _lr_taint(ctx):
+    """Var names derived from an autoincreased decay/step counter."""
+    tainted = set()
+    for block in ctx.program.blocks:
+        for name in block.vars:
+            if name.endswith('@') and _DECAY_COUNTER_MARK in name:
+                tainted.add(name)
+    if not tainted:
+        return tainted
+    for block in ctx.program.blocks:
+        for op in block.ops:
+            if set(op.input_names()) & tainted:
+                tainted |= set(op.output_names())
+    return tainted
+
+
+@register_pass('numeric_hazard')
+def run(ctx):
+    diags = []
+    tainted = _lr_taint(ctx)
+    for block in ctx.program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type == 'log':
+                ins = op.input_names()
+                prod = ctx.producer_of(block, ins[0]) if ins else None
+                if prod is not None and prod.type in _LOG_OF:
+                    diags.append(ctx.diag(
+                        'D012', 'warning',
+                        'log(%s(x)) underflows to -inf when the inner '
+                        'probability reaches 0' % prod.type,
+                        block=block, op=op, op_index=i,
+                        var=ins[0],
+                        fixit='use the fused %s op' % _LOG_OF[prod.type],
+                        pass_name='numeric_hazard'))
+                elif not ins or not (
+                        _is_guarded(ctx, block, ins[0]) or
+                        _is_safe_positive(ctx, block, ins[0])):
+                    diags.append(ctx.diag(
+                        'D012', 'warning',
+                        'log over an unclipped input: log(0) = -inf and '
+                        'log(x<0) = nan poison the whole step',
+                        block=block, op=op, op_index=i,
+                        var=ins[0] if ins else None,
+                        fixit='clip the input to [eps, inf) first '
+                              '(layers.clip)',
+                        pass_name='numeric_hazard'))
+            elif op.type == 'elementwise_div':
+                y = op.input('Y')
+                if y and not _is_safe_positive(ctx, block, y[0]):
+                    yv = block._find_var_recursive(y[0])
+                    if yv is not None and getattr(yv, 'is_data', False):
+                        why = 'a raw feed'
+                    elif ctx.producer_of(block, y[0]) is None:
+                        why = 'an unguarded value'
+                    else:
+                        why = ('produced by "%s"' %
+                               ctx.producer_of(block, y[0]).type)
+                    diags.append(ctx.diag(
+                        'D012', 'warning',
+                        'division by %s with no positivity guarantee: a '
+                        'zero divisor yields inf/nan' % why,
+                        block=block, op=op, op_index=i, var=y[0],
+                        fixit='clip the divisor away from zero or add '
+                              'an epsilon',
+                        pass_name='numeric_hazard'))
+            elif op.type == 'exp':
+                if _softmax_pattern(ctx, block, op, i):
+                    if not _has_max_subtraction(ctx, block, op):
+                        diags.append(ctx.diag(
+                            'D013', 'warning',
+                            'softmax assembled by hand without max-'
+                            'subtraction: exp overflows fp32 once logits '
+                            'exceed ~88',
+                            block=block, op=op, op_index=i,
+                            fixit='use layers.softmax, or subtract '
+                                  'reduce_max(x) before exp',
+                            pass_name='numeric_hazard'))
+                else:
+                    ins = op.input_names()
+                    iv = (block._find_var_recursive(ins[0]) if ins
+                          else None)
+                    if iv is not None and getattr(iv, 'is_data', False):
+                        diags.append(ctx.diag(
+                            'D012', 'warning',
+                            'exp over a raw feed: unbounded inputs '
+                            'overflow fp32 past ~88',
+                            block=block, op=op, op_index=i, var=ins[0],
+                            fixit='clip the exponent input',
+                            pass_name='numeric_hazard'))
+            # ---- D014: degenerate decay constants --------------------
+            if not tainted:
+                continue
+            if op.type == 'elementwise_pow':
+                x, y = op.input('X'), op.input('Y')
+                if x and y and y[0] in tainted:
+                    base = _const_value(ctx.producer_of(block, x[0]))
+                    if base is not None and \
+                            (float(base) >= 1.0 or float(base) <= 0.0):
+                        diags.append(ctx.diag(
+                            'D014', 'warning',
+                            'decay base %g raised to the step counter '
+                            '%s' % (float(base),
+                                    'never decays (>= 1)'
+                                    if float(base) >= 1.0 else
+                                    'is non-positive (nan/0 schedule)'),
+                            block=block, op=op, op_index=i, var=x[0],
+                            fixit='use a decay_rate in (0, 1)',
+                            pass_name='numeric_hazard'))
+                elif x and y and x[0] in tainted:
+                    # negative powers (noam's step**-0.5) DO decay; only
+                    # power == 0 degenerates to a constant schedule
+                    p = _const_value(ctx.producer_of(block, y[0]))
+                    if p is not None and float(p) == 0.0:
+                        diags.append(ctx.diag(
+                            'D014', 'warning',
+                            'decay power 0 makes the schedule a '
+                            'constant 1',
+                            block=block, op=op, op_index=i,
+                            fixit='use a non-zero power',
+                            pass_name='numeric_hazard'))
+            elif op.type == 'scale' and \
+                    set(op.input_names()) & tainted:
+                try:
+                    s = float(op.attrs.get('scale', 1.0))
+                    b = float(op.attrs.get('bias', 0.0))
+                except (TypeError, ValueError):
+                    continue
+                if s == 0.0 and b == 0.0:
+                    diags.append(ctx.diag(
+                        'D014', 'warning',
+                        'learning-rate schedule multiplied by 0: the '
+                        'effective LR is constant 0',
+                        block=block, op=op, op_index=i,
+                        fixit='use a non-zero decay factor',
+                        pass_name='numeric_hazard'))
+    return diags

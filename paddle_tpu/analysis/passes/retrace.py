@@ -1,0 +1,100 @@
+"""D010/D011: retrace hazards, predicted statically.
+
+The PR-2 retrace explainer (observability/retrace.py) names the cache-key
+component that changed AFTER a retrace already cost a compile; this pass
+reports the same hazards from the program alone, before anything runs:
+
+  D010  a feed var has a dynamic (-1) dim.  Every distinct extent seen
+        at run time is a fresh jit signature -> a fresh trace+compile.
+        Severity is graded: a dynamic BATCH dim (axis 0) is info — every
+        minibatch model has one, and a FeedBucketer with a mask feed
+        collapses it onto a handful of boundaries; dynamic sequence/
+        feature dims are warnings, annotated with whether the provided
+        bucketer (Program.lint(bucketer=...)) already covers them.
+  D011  an op attr holds a numpy array: unhashable in cache keys, and a
+        per-run mutation via op.set_attr bumps the program version and
+        forces a full re-lower every step.
+"""
+import numpy as np
+
+from ..engine import register_pass
+
+__all__ = ['run']
+
+
+def _covered_axes(bucketer, name, lod_level):
+    if bucketer is None:
+        return set()
+    if hasattr(bucketer, 'covered_axes'):
+        return bucketer.covered_axes(name, lod_level=lod_level)
+    return {0}
+
+
+@register_pass('retrace_hazard')
+def run(ctx):
+    diags = []
+    root = ctx.program.global_block()
+    bucketer = ctx.bucketer
+    for name, v in root.vars.items():
+        if not getattr(v, 'is_data', False) or v.shape is None:
+            continue
+        if '@' in name:
+            continue  # @LENGTH companions follow their owner's bucketing
+        lod = getattr(v, 'lod_level', 0)
+        covered = _covered_axes(bucketer, name, lod)
+        for axis, d in enumerate(v.shape):
+            if d not in (-1, None):
+                continue
+            if axis in covered:
+                continue
+            if axis == 0:
+                if bucketer is not None:
+                    continue  # batch padding is the bucketer's default job
+                diags.append(ctx.diag(
+                    'D010', 'info',
+                    'feed "%s" has a dynamic batch dim: every distinct '
+                    'batch size compiles a fresh executable (ragged '
+                    'epoch tails retrace)' % name,
+                    block=root, var=name,
+                    fixit='wrap the feed stream in FeedBucketer('
+                          'mask_name=...) to pad batches onto bucket '
+                          'boundaries',
+                    pass_name='retrace_hazard'))
+            elif axis == 1 and lod <= 1:
+                how = ('add "%s" to FeedBucketer(seq_names=...)' % name
+                       if bucketer is not None else
+                       'bucket it via FeedBucketer(seq_names=[%r])' % name)
+                diags.append(ctx.diag(
+                    'D010', 'warning',
+                    'feed "%s" has a dynamic sequence dim (axis 1) not '
+                    'covered by any bucket: every distinct padded length '
+                    'is a fresh trace+compile — the retrace explainer '
+                    'would report these as "bucketable" after the fact'
+                    % name,
+                    block=root, var=name, fixit=how,
+                    pass_name='retrace_hazard'))
+            else:
+                diags.append(ctx.diag(
+                    'D010', 'warning',
+                    'feed "%s" has a dynamic dim on axis %d that no '
+                    'bucketer can pad: every distinct extent compiles a '
+                    'fresh executable' % (name, axis),
+                    block=root, var=name,
+                    fixit='declare a static extent for axis %d' % axis,
+                    pass_name='retrace_hazard'))
+    for block in ctx.program.blocks:
+        for i, op in enumerate(block.ops):
+            for k, val in op.attrs.items():
+                if isinstance(val, np.ndarray):
+                    diags.append(ctx.diag(
+                        'D011', 'warning',
+                        'op "%s" attr "%s" holds a %s array: array attrs '
+                        'are unhashable in the lowering-cache key, and '
+                        'mutating one per run (op.set_attr) bumps the '
+                        'program version — a full re-lower every step'
+                        % (op.type, k, 'x'.join(map(str, val.shape))),
+                        block=block, op=op, op_index=i,
+                        fixit='feed the tensor as a (persistable) input '
+                              'instead of an attr',
+                        pass_name='retrace_hazard'))
+    return diags

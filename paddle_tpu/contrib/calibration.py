@@ -150,7 +150,7 @@ class Calibrator(object):
                         for n in names:
                             if n in scales and n not in rewired:
                                 qn = n + '.int8calib'
-                                out = block.create_var(
+                                block.create_var(
                                     name=qn,
                                     shape=block._find_var_recursive(
                                         n).shape,

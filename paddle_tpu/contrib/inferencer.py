@@ -1,6 +1,4 @@
 """High-level Inferencer (parity: reference contrib/inferencer.py)."""
-import numpy as np
-
 from ..core import framework
 from ..core.executor import Executor, Scope, scope_guard
 from .. import io as fluid_io

@@ -164,7 +164,6 @@ class QuantizeTranspiler(object):
         """Store quantizable weights as int8 + float scale in the scope
         (deploy-size artifact; ops dequantize on read)."""
         from ..core.executor import global_scope
-        import jax.numpy as jnp
         scope = scope or global_scope()
         rmax = float(2 ** (self.weight_bits - 1) - 1)
         converted = {}

@@ -14,7 +14,7 @@ Variables in the block: regularizers, gradient clipping and optimizer ops
 appended afterwards operate on them exactly like in the reference.
 """
 from . import framework
-from .framework import Variable, Parameter, OpRole
+from .framework import Variable, OpRole
 
 __all__ = ['append_backward', 'gradients', 'calc_gradient']
 

@@ -31,8 +31,6 @@ by a **speculative body trace** on the pre-loop values; the speculative
 outputs are discarded, so XLA dead-code-eliminates the extra trace and only
 the zero-initialised buffer survives.
 """
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax import lax

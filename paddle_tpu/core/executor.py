@@ -15,7 +15,7 @@ import numpy as np
 
 from . import registry
 from . import compile_cache as _cc
-from .framework import (Variable, Parameter, default_main_program, TPUPlace,
+from .framework import (Variable, default_main_program, TPUPlace,
                         Program)
 from .. import observability as _obs
 
@@ -366,10 +366,16 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
     import jax
     import jax.numpy as jnp
 
-    # SSA-graph race detection analog (SURVEY §2.8): fail def-use
-    # ordering bugs at build with the op+var named, not mid-trace
-    from .validation import validate_def_use
-    validate_def_use(program, feed_names)
+    # Static analysis at the lowering-cache miss (SSA-graph race
+    # detection analog, SURVEY §2.8, grown into the full pt-lint pass
+    # suite): def-use ordering bugs, shape/dtype mismatches, donation
+    # conflicts etc. fail at build with the op+var named, not mid-trace.
+    # PT_LINT=strict (default) raises on error findings; =warn demotes
+    # them to one LintWarning; =0 restores the raw mid-trace failures.
+    from ..analysis import apply_lint_policy, lint_mode
+    apply_lint_policy(program, feed_names=feed_names,
+                      fetch_names=fetch_names, mode=lint_mode(),
+                      header='program lint failed before lowering')
 
     block = program.global_block()
     ops = block.ops

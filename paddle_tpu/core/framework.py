@@ -45,7 +45,7 @@ class Place(object):
         return "%s(%d)" % (type(self).__name__, self.device_id)
 
     def __eq__(self, other):
-        return type(self) == type(other) and self.device_id == other.device_id
+        return type(self) is type(other) and self.device_id == other.device_id
 
 
 class TPUPlace(Place):
@@ -381,6 +381,38 @@ class Parameter(Variable):
 
 # ---------------------------------------------------------------- Operator
 
+# Source-location capture: each Operator remembers the (file, line) of the
+# model code that created it, so lint diagnostics (paddle_tpu.analysis)
+# point at the user's line instead of deep framework internals.  Frames
+# inside the package are skipped, EXCEPT the bundled model zoo — a finding
+# in paddle_tpu/models should name the model line.  PT_SOURCE_LOC=0
+# disables the walk entirely (it is a few frame hops per op).
+import os as _os
+import sys as _sys
+
+_PKG_DIR = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+_MODELS_DIR = _os.path.join(_PKG_DIR, 'models')
+_CAPTURE_SOURCE_LOC = _os.environ.get('PT_SOURCE_LOC', '1') not in (
+    '0', 'false', 'False')
+
+
+def _capture_source_loc():
+    if not _CAPTURE_SOURCE_LOC:
+        return None
+    try:
+        f = _sys._getframe(2)
+    except ValueError:
+        return None
+    depth = 0
+    while f is not None and depth < 32:
+        fn = f.f_code.co_filename
+        if not fn.startswith(_PKG_DIR) or fn.startswith(_MODELS_DIR):
+            return (fn, f.f_lineno)
+        f = f.f_back
+        depth += 1
+    return None
+
+
 class Operator(object):
     """One node in a Block: op type + named input/output slots + attrs.
 
@@ -392,6 +424,7 @@ class Operator(object):
     def __init__(self, block, type, inputs=None, outputs=None, attrs=None):
         self.block = block
         self.type = type
+        self.source_loc = _capture_source_loc()
         self.attrs = dict(attrs or {})
         self.attrs.setdefault('op_role', _current_role[-1])
         if _recompute_stack:
@@ -713,6 +746,7 @@ class Program(object):
                     nattrs['is_test'] = True
                 nop = Operator(nb, op.type)
                 nop.attrs = nattrs
+                nop.source_loc = op.source_loc
                 nop.inputs = {k: list(v) for k, v in op.inputs.items()}
                 nop.outputs = {k: list(v) for k, v in op.outputs.items()}
                 nop.input_is_list = dict(op.input_is_list)
@@ -750,6 +784,26 @@ class Program(object):
         b.vars = {n: v for n, v in b.vars.items() if n in used}
         p._bump()
         return p
+
+    def lint(self, feed_names=(), fetch_list=(), bucketer=None,
+             passes=None):
+        """Static analysis without compiling: run the paddle_tpu.analysis
+        passes (def-use, shape/dtype abstract interpretation, dead ops,
+        donation conflicts, retrace hazards, numerical hazards) and
+        return a LintResult.  Never raises — strict enforcement is the
+        executor's PT_LINT policy (docs/analysis.md).
+
+        fetch_list anchors the dead-op pass; bucketer (a
+        data_feeder.FeedBucketer) tells the retrace pass which dynamic
+        feed dims are already padded onto stable bucket signatures.
+        """
+        from ..analysis import lint_program
+        fetch_names = []
+        for f in (fetch_list or ()):
+            fetch_names.append(f.name if isinstance(f, Variable) else f)
+        return lint_program(self, feed_names=tuple(feed_names),
+                            fetch_names=tuple(fetch_names),
+                            bucketer=bucketer, passes=passes)
 
     def to_string(self, throw_on_error=False, with_details=False):
         return "\n".join(b.to_string() for b in self.blocks)

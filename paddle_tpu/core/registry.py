@@ -20,7 +20,8 @@ import jax
 
 _REGISTRY = {}
 
-__all__ = ['register', 'has_op', 'get_op', 'OpDef', 'InferCtx', 'ExecCtx']
+__all__ = ['register', 'has_op', 'get_op', 'op_names', 'OpDef', 'InferCtx',
+           'ExecCtx']
 
 
 class OpDef(object):
@@ -48,6 +49,13 @@ def get_op(name):
     if name not in _REGISTRY:
         raise KeyError('no JAX impl registered for op "%s"' % name)
     return _REGISTRY[name]
+
+
+def op_names():
+    """All registered op types (sorted) — the analysis package uses this
+    for coverage checks and did-you-mean suggestions on unknown ops."""
+    _ensure_ops_loaded()
+    return sorted(_REGISTRY)
 
 
 _ops_loaded = [False]

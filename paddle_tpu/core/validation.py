@@ -5,67 +5,26 @@ Parity: reference ParallelExecutor's SSA-graph dependency tracking
 between concurrently scheduled op kernels.  Under whole-block XLA
 lowering ops execute in program order inside ONE executable, so a "race"
 can only appear as a def-use ordering bug: an op reading a var no
-earlier op, feed, parameter, or persistable defines.  The executor runs
-this walk on every lowering-cache miss so such programs fail at build
-with the op and var named, instead of a bare KeyError mid-trace.
+earlier op, feed, parameter, or persistable defines.
+
+The walk itself now lives in paddle_tpu/analysis/passes/defuse.py as the
+D001 lint pass (one engine serves Program.lint(), tools/pt_lint.py, and
+the executor's PT_LINT hook); this module keeps the historical
+first-error ValueError contract on top of it, with the upgraded
+diagnostics: full block path and a did-you-mean suggestion for the
+nearest var name by edit distance.
 """
-from .framework import Parameter
 
 __all__ = ['validate_def_use']
 
 
-def _initially_defined(program, feed_names):
-    defined = set(feed_names)
-    root = program.global_block()
-    for name, v in root.vars.items():
-        if isinstance(v, Parameter) or v.persistable or \
-                getattr(v, 'is_data', False):
-            defined.add(name)
-            if getattr(v, 'lod_level', 0) > 0:
-                defined.add(name + '@LENGTH')
-    return defined
-
-
 def validate_def_use(program, feed_names=()):
     """Raise ValueError on the first op input read before definition."""
-
-    def walk(block, defined):
-        for op in block.ops:
-            for slot, names in op.inputs.items():
-                for n in names:
-                    if n is None or n in defined:
-                        continue
-                    v = block._find_var_recursive(n)
-                    if v is not None and (isinstance(v, Parameter) or
-                                          v.persistable or
-                                          getattr(v, 'is_data', False) or
-                                          # arrays allocate on first
-                                          # write; the runtime raises its
-                                          # own read-before-write error
-                                          getattr(v, 'is_tensor_array',
-                                                  False)):
-                        defined.add(n)
-                        continue
-                    raise ValueError(
-                        'def-use violation: op "%s" reads var "%s" '
-                        'before any prior op, feed, parameter or '
-                        'persistable defines it (block %d). If this var '
-                        'is produced later in the program, reorder the '
-                        'ops; if it should be fed, add it to the feed '
-                        'list.' % (op.type, n, block.idx))
-            sub = op.attrs.get('sub_block')
-            if sub is not None:
-                inner = set(defined)
-                if op.type == 'recurrent':
-                    inner |= set(op.attrs.get('step_vars', ()))
-                    inner |= set(op.attrs.get('mem_vars', ()))
-                # body-LOCAL temps do NOT survive the loop: the lowering
-                # writes back only carries (vars that pre-existed), so
-                # sub-block definitions are deliberately not merged — a
-                # later read of a body temp is itself a def-use violation
-                walk(program.block(sub), inner)
-            defined.update(n for n in op.output_names() if n)
-        return defined
-
-    walk(program.global_block(),
-         _initially_defined(program, feed_names))
+    from ..analysis import lint_program, LintError, LintResult
+    result = lint_program(program, feed_names=feed_names,
+                          passes=('def_use',))
+    errors = [d for d in result.errors if d.code == 'D001']
+    if errors:
+        # first-error contract: historical callers matched one violation
+        raise LintError(LintResult(errors[:1]),
+                        header='def-use violation')

@@ -159,6 +159,20 @@ class FeedBucketer(object):
                 (target - batch) / float(target))
         return out, batch
 
+    def covered_axes(self, name, lod_level=0):
+        """Which axes of feed `name` this bucketer stabilizes onto bucket
+        boundaries: axis 0 (batch) always, axis 1 when the feed is named
+        in seq_names.  Nested-LoD feeds (lod_level > 1) pass through
+        bucket_feed unpadded, so nothing is covered.  The lint retrace-
+        hazard pass (analysis/passes/retrace.py) consumes this to decide
+        which dynamic dims still threaten a per-shape recompile."""
+        if lod_level > 1:
+            return set()
+        axes = {0}
+        if name in self.seq_names:
+            axes.add(1)
+        return axes
+
     def wrap(self, feeds):
         """Generator over an iterable of feed dicts, bucketing each.
         Yields just the padded feeds (the mask feed carries validity), so

@@ -3,7 +3,6 @@
 Synthetic Markov-chain text with a fixed transition structure so that a
 real LM can learn it.
 """
-import numpy as np
 from .common import deterministic_rng
 
 __all__ = ['train', 'test', 'build_dict']

@@ -1,6 +1,5 @@
 """VOC2012 segmentation (parity: python/paddle/dataset/voc2012.py).
 Synthetic image + dense label pairs."""
-import numpy as np
 from .common import deterministic_rng
 
 __all__ = ['train', 'test', 'val']

@@ -70,9 +70,50 @@ def pprint_program_codes(program, stream=None):
     return code
 
 
-def draw_block_graphviz(block, highlights=None, path='./graph.dot'):
-    """Write a Graphviz dot file of the block's op/var dataflow graph."""
+# op fill colors per worst lint severity (analysis.LintResult)
+_LINT_OP_COLORS = {'error': 'tomato', 'warning': 'orange',
+                   'info': 'khaki'}
+_LINT_VAR_COLORS = {'error': 'lightpink', 'warning': 'moccasin',
+                    'info': 'lightyellow'}
+
+
+def _lint_maps(block, lint_result):
+    """(op_index -> severity, var name -> (severity, codes)) for this
+    block, from a LintResult (analysis/diagnostics.py)."""
+    if lint_result is None:
+        return {}, {}
+    op_sev = {op_i: sev
+              for (b_i, op_i), sev in lint_result.op_findings().items()
+              if b_i == block.idx}
+    var_sev = {}
+    rank = {'info': 0, 'warning': 1, 'error': 2}
+    for d in lint_result:
+        if d.var is None or (d.block_idx is not None and
+                             d.block_idx != block.idx):
+            continue
+        sev, codes = var_sev.get(d.var, ('info', []))
+        if rank[d.severity] >= rank[sev]:
+            sev = d.severity
+        var_sev[d.var] = (sev, codes + [d.code])
+    return op_sev, var_sev
+
+
+def draw_block_graphviz(block, highlights=None, path='./graph.dot',
+                        lint_result=None):
+    """Write a Graphviz dot file of the block's op/var dataflow graph.
+
+    With `lint_result` (a LintResult from Program.lint()), flagged ops
+    and vars are color-coded by worst severity — dead ops, shape
+    mismatches, and donation conflicts become visible in the dump — and
+    flagged ops grow a tooltip-style second label line with the codes.
+    """
     highlights = set(highlights or ())
+    op_sev, var_sev = _lint_maps(block, lint_result)
+    op_codes = {}
+    if lint_result is not None:
+        for d in lint_result:
+            if d.op_index is not None and d.block_idx == block.idx:
+                op_codes.setdefault(d.op_index, []).append(d.code)
 
     def vid(name):
         return 'var_' + _RESERVED.sub('_', name)
@@ -86,16 +127,30 @@ def draw_block_graphviz(block, highlights=None, path='./graph.dot'):
         seen_vars.add(name)
         v = block._find_var_recursive(name)
         shape = list(v.shape or ()) if v is not None else '?'
-        color = ('red' if name in highlights else
-                 'lightblue' if isinstance(v, Parameter) else 'white')
+        label = '%s\\n%s' % (name, shape)
+        if name in var_sev:
+            sev, codes = var_sev[name]
+            color = _LINT_VAR_COLORS[sev]
+            label += '\\n' + ','.join(sorted(set(codes)))
+        elif name in highlights:
+            color = 'red'
+        elif isinstance(v, Parameter):
+            color = 'lightblue'
+        else:
+            color = 'white'
         lines.append(
-            '  %s [label="%s\\n%s" shape=oval style=filled '
-            'fillcolor=%s];' % (vid(name), name, shape, color))
+            '  %s [label="%s" shape=oval style=filled '
+            'fillcolor=%s];' % (vid(name), label, color))
 
     for i, op in enumerate(block.ops):
         oid = 'op_%d' % i
+        label = op.type
+        color = 'lightgrey'
+        if i in op_sev:
+            color = _LINT_OP_COLORS[op_sev[i]]
+            label += '\\n' + ','.join(sorted(set(op_codes.get(i, ()))))
         lines.append('  %s [label="%s" shape=box style=filled '
-                     'fillcolor=lightgrey];' % (oid, op.type))
+                     'fillcolor=%s];' % (oid, label, color))
         for n in op.input_names():
             emit_var(n)
             lines.append('  %s -> %s;' % (vid(n), oid))
@@ -111,5 +166,12 @@ def draw_block_graphviz(block, highlights=None, path='./graph.dot'):
     return dot
 
 
-def draw_program_graphviz(program, path='./graph.dot'):
-    return draw_block_graphviz(program.global_block(), path=path)
+def draw_program_graphviz(program, path='./graph.dot', lint_result=None,
+                          feed_names=(), fetch_list=()):
+    """Dot dump of the root block; pass lint_result (or let it run the
+    linter itself via lint_result='auto') to color-code findings."""
+    if lint_result == 'auto':
+        lint_result = program.lint(feed_names=feed_names,
+                                   fetch_list=fetch_list)
+    return draw_block_graphviz(program.global_block(), path=path,
+                               lint_result=lint_result)

@@ -95,6 +95,16 @@ class Predictor(object):
     def get_output_names(self):
         return list(self._fetch_names)
 
+    def lint(self, bucketer=None):
+        """Static analysis of the loaded inference program (the saved
+        model's feed/fetch signature anchors the def-use and dead-op
+        passes).  Returns a paddle_tpu.analysis.LintResult; the same
+        report is available from the CLI as
+        ``python tools/pt_lint.py <model_dir>``."""
+        return self._program.lint(feed_names=self._feed_names,
+                                  fetch_list=self._fetch_names,
+                                  bucketer=bucketer)
+
     def _fn_for(self, feeds):
         if not _cc.disk_enabled():
             return self._fn, self._params_in

@@ -7,7 +7,7 @@ lower to jax.random ops keyed off the startup program's seed.
 import numpy as np
 
 from .core.framework import default_startup_program
-from .core.dtypes import dtype_str
+from .core.dtypes import dtype_str  # noqa: F401 - legacy re-export
 
 __all__ = [
     'Constant', 'Uniform', 'Normal', 'TruncatedNormal', 'Xavier', 'Bilinear',

@@ -121,6 +121,11 @@ def program_to_desc(program):
                 'input_is_list': op.input_is_list,
                 'output_is_list': op.output_is_list,
                 'attrs': _jsonable_attrs(op.attrs),
+                # lint diagnostics on a re-loaded model still point at
+                # the model code that built the op (analysis package)
+                'source_loc': (list(op.source_loc)
+                               if getattr(op, 'source_loc', None)
+                               else None),
             })
         blocks.append({'idx': b.idx, 'parent_idx': b.parent_idx,
                        'vars': vars_, 'ops': ops})
@@ -177,6 +182,8 @@ def desc_to_program(desc):
                 else:
                     attrs[k] = v
             op.attrs = attrs
+            if od.get('source_loc'):
+                op.source_loc = tuple(od['source_loc'])
             b.ops.append(op)
         program.blocks.append(b)
     program._bump()

@@ -6,8 +6,7 @@ host-side prefetch pipelines (the device pipeline is the jitted step); a
 ragged (lod_level>0) data var is declared as padded [-1, -1, ...] plus a
 companion `<name>@LENGTH` int32 vector fed automatically from a LoDTensor.
 """
-from ..core.framework import default_main_program, default_startup_program
-from ..core.layer_helper import LayerHelper
+from ..core.framework import default_main_program
 from ..core.lod import LENGTH_SUFFIX, OUTER_SUFFIX
 
 __all__ = ['data', 'py_reader', 'shuffle', 'batch', 'double_buffer',
@@ -18,7 +17,6 @@ __all__ = ['data', 'py_reader', 'shuffle', 'batch', 'double_buffer',
 def data(name, shape, dtype='float32', lod_level=0, type=None,
          append_batch_size=True, stop_gradient=True):
     """Declare an input variable (reference layers/io.py data())."""
-    helper = LayerHelper('data', name=name)
     shape = list(shape)
     if append_batch_size:
         # negative dims inside shape are normalized to -1 like the ref

@@ -6,7 +6,6 @@ persistable `@LR_DECAY_COUNTER@` variable.
 """
 import math
 
-from ..core.layer_helper import LayerHelper
 from . import nn
 from . import ops
 from . import tensor
@@ -62,7 +61,6 @@ def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
     global_step = _decay_step_counter()
     if cycle:
         div_res = ops.ceil(global_step / decay_steps)
-        zero_var = tensor.fill_constant(shape=[1], dtype='float32', value=0.0)
         one_var = tensor.fill_constant(shape=[1], dtype='float32', value=1.0)
         # max(div_res, 1) when step == 0
         div_res = nn.elementwise_max(div_res, one_var)
@@ -79,9 +77,7 @@ def piecewise_decay(boundaries, values):
     """lr = values[i] for step in (boundaries[i-1], boundaries[i]]."""
     assert len(values) - len(boundaries) == 1
     global_step = _decay_step_counter()
-    lr = tensor.fill_constant([1], 'float32', values[-1])
     # piecewise via sum of indicator windows (branch-free, XLA-friendly)
-    import numpy as np
     prev = None
     pieces = []
     for i, b in enumerate(boundaries):

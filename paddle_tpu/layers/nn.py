@@ -283,8 +283,6 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
          dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
          default_initializer=None, seed=-1):
     """Multi-layer (cudnn-style) LSTM, ref nn.py lstm().  Stacked scans."""
-    helper = LayerHelper('multilayer_lstm', name=name)
-    dtype = input.dtype
     x = input
     last_hs, last_cs = [], []
     for layer in range(num_layers):
@@ -1327,7 +1325,6 @@ def hash(input, hash_size, num_hash=1, name=None):
 def lod_reset(x, y=None, target_lod=None):
     """In padded representation the data layout is unchanged; only the
     lengths binding moves (ref lod_reset_op)."""
-    helper = LayerHelper('lod_reset')
     out = _simple('assign', x)
     if y is not None:
         out.lod_level = max(1, y.lod_level)

@@ -1,9 +1,21 @@
 """Graph drawing helpers (parity: reference fluid/net_drawer.py /
-graphviz.py); delegates to debugger's dot export."""
+graphviz.py); delegates to debugger's dot export.  `draw_graph` can run
+the static linter first (lint=True) so dead ops, shape errors, and
+donation conflicts are highlighted in the rendered graph."""
 from .debugger import draw_block_graphviz, draw_program_graphviz  # noqa
 
 __all__ = ['draw_graph', 'draw_block_graphviz', 'draw_program_graphviz']
 
 
-def draw_graph(startup_program, main_program, path='./graph.dot', **kwargs):
-    return draw_program_graphviz(main_program, path=path)
+def draw_graph(startup_program, main_program, path='./graph.dot',
+               lint=False, feed_names=(), fetch_list=(), **kwargs):
+    """Dot dump of main_program's root block.  With lint=True the
+    program is linted (Program.lint) and flagged ops/vars are
+    color-coded by severity; feed_names/fetch_list anchor the def-use
+    and dead-op passes."""
+    lint_result = None
+    if lint:
+        lint_result = main_program.lint(feed_names=feed_names,
+                                        fetch_list=fetch_list)
+    return draw_program_graphviz(main_program, path=path,
+                                 lint_result=lint_result)

@@ -16,7 +16,7 @@ from jax import lax
 
 from ..core.registry import register
 from ..core.dtypes import jax_dtype
-from .sequence import _length_or_full, _lstm_scan, _ACTS
+from .sequence import _length_or_full, _ACTS
 
 _NEG = -1e30  # log-space "minus infinity" that survives bf16/f32 adds
 

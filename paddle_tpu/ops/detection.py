@@ -89,7 +89,6 @@ def prior_box(ctx, ins, attrs):
             boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
     for ms, mxs in zip(min_sizes, max_sizes or []):
         boxes.append((np.sqrt(ms * mxs), np.sqrt(ms * mxs)))
-    nprior = len(boxes)
     cx = (jnp.arange(W) + offset) * sw
     cy = (jnp.arange(H) + offset) * sh
     gy, gx = jnp.meshgrid(cy, cx, indexing='ij')
@@ -180,7 +179,6 @@ def yolov3_loss(ctx, ins, attrs):
     anchors = attrs['anchors']
     anchor_mask = attrs.get('anchor_mask', list(range(len(anchors) // 2)))
     class_num = attrs['class_num']
-    ignore_thresh = attrs.get('ignore_thresh', 0.7)
     downsample = attrs.get('downsample_ratio', 32)
     N, C, H, W = x.shape
     na = len(anchor_mask)
@@ -942,7 +940,6 @@ def generate_mask_labels(ctx, ins, attrs):
     num_cls = int(attrs.get('num_classes', 81))
     R = int(attrs.get('resolution', 14))
     N, B = rois.shape[0], rois.shape[1]
-    P = segms.shape[2]
 
     def rasterize(poly, box):
         # sample centers of an RxR grid over the roi box

@@ -13,7 +13,6 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register
-from ..core.dtypes import convert_dtype
 
 
 def _pair(v, n=2):
@@ -488,7 +487,6 @@ def grid_sampler(ctx, ins, attrs):
 @register('affine_grid')
 def affine_grid(ctx, ins, attrs):
     theta = ins['Theta']  # [N, 2, 3]
-    n = theta.shape[0]
     _, _, h, w = attrs['output_shape'] if 'output_shape' in attrs else \
         (0, 0, 0, 0)
     ys = jnp.linspace(-1, 1, h)

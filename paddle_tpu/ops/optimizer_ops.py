@@ -7,7 +7,6 @@ jitted train-step executable with parameter buffers donated, so updates are
 in-place on device.
 """
 import jax.numpy as jnp
-from jax import lax
 
 from ..core.registry import register
 

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register
-from ..core.dtypes import convert_dtype, jax_dtype
+from ..core.dtypes import jax_dtype
 
 
 def _key(ctx, attrs):
@@ -63,7 +63,6 @@ def random_crop(ctx, ins, attrs):
         limit = x.shape[nlead + i] - s
         k = jax.random.fold_in(key, i)
         starts.append(jax.random.randint(k, (), 0, max(limit, 0) + 1))
-    idx = tuple([slice(None)] * nlead)
     out = jax.lax.dynamic_slice(
         x, [0] * nlead + [s for s in starts],
         list(x.shape[:nlead]) + list(shape))

@@ -10,7 +10,6 @@ an int32 `Length` [B] input — static shapes, vectorized over the batch, and
 RNN recurrences are `lax.scan` (single compiled loop, no Python unrolling).
 Ragged inputs are converted once at feed time (core/lod.py).
 """
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -364,7 +363,6 @@ def lstm(ctx, ins, attrs):
 @register('cudnn_lstm')
 def cudnn_lstm(ctx, ins, attrs):
     """Multi-layer LSTM (ref cudnn_lstm_op): here just stacked scans."""
-    x = ins['Input']  # [B, T, D_in]
     raise NotImplementedError('use layers.lstm / dynamic_lstm')
 
 

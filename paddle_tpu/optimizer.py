@@ -132,7 +132,6 @@ class Optimizer(object):
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
-        loss = None
         # any grad var's block gives the program
         block = params_grads[0][0].block
         with op_role_guard(OpRole.Optimize):

@@ -7,7 +7,6 @@ import sys as _sys
 from .batch import batch  # noqa
 from . import reader  # noqa
 from . import dataset  # noqa
-from . import __init__ as _pkg
 
 fluid = _sys.modules['paddle_tpu']
 

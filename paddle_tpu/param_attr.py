@@ -2,7 +2,7 @@
 
 Parity: reference python/paddle/fluid/param_attr.py.
 """
-from .core.dtypes import dtype_str
+from .core.dtypes import dtype_str  # noqa: F401 - legacy re-export
 
 __all__ = ['ParamAttr', 'WeightNormParamAttr']
 

@@ -25,7 +25,7 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs + scope + name generator."""
-    import paddle_tpu as fluid
+    import paddle_tpu as fluid  # noqa: F401 - warm the package once
     from paddle_tpu.core import framework, unique_name
     from paddle_tpu.core import executor as executor_mod
     main, startup = framework.Program(), framework.Program()

@@ -14,7 +14,6 @@ Parity: reference contrib mixed-precision era behavior
 exponent range as f32 — no loss scaling needed).
 """
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers

@@ -1,0 +1,431 @@
+"""Static analyzer (paddle_tpu.analysis): per-pass positive/negative
+coverage, the PT_LINT executor hook, and the pt_lint CLI on a saved
+model (docs/analysis.md documents codes D001..D014)."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis import LintError, LintWarning, lint_program
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', 'tools'))
+import pt_lint  # noqa: E402
+
+
+def _codes(result):
+    return set(result.codes())
+
+
+def _build_clean():
+    """fit_a_line-style clean training program."""
+    import paddle_tpu.models.simple as simple
+    prog, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, start):
+        m = simple.fit_a_line()
+    return prog, start, m
+
+
+# ------------------------------------------------------- def-use (D001)
+
+def test_defuse_clean():
+    prog, _, m = _build_clean()
+    res = prog.lint(feed_names=['x', 'y'], fetch_list=[m['loss']])
+    assert 'D001' not in _codes(res)
+
+
+def test_defuse_did_you_mean_and_valueerror():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('input_ids', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        out = blk.create_var(name='out', shape=[-1, 4], dtype='float32')
+        # typo'd read: input_idz instead of input_ids
+        blk.append_op('scale', inputs={'X': 'input_idz'},
+                      outputs={'Out': out}, attrs={'scale': 1.0},
+                      infer_shape=False)
+    res = prog.lint(feed_names=['input_ids'], fetch_list=['out'])
+    d001 = [d for d in res.errors if d.code == 'D001']
+    assert len(d001) == 1
+    assert 'input_idz' in d001[0].message
+    assert 'input_ids' in (d001[0].fixit or '')       # did-you-mean
+    assert d001[0].block_path == 'block 0'
+    # the historical first-error ValueError contract still holds
+    from paddle_tpu.core.validation import validate_def_use
+    with pytest.raises(ValueError, match='input_idz'):
+        validate_def_use(prog, feed_names=('input_ids',))
+    assert x is not None
+
+
+# ------------------------------------- shape/dtype interpreter (D002-4)
+
+def test_shape_mismatch_reported_at_op():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        w = blk.create_parameter(name='W', shape=[3, 5], dtype='float32')
+        bad = blk.create_var(name='bad', shape=[-1, 5], dtype='float32')
+        blk.append_op('mul', inputs={'X': x, 'Y': w},
+                      outputs={'Out': bad}, attrs={}, infer_shape=False)
+    res = prog.lint(feed_names=['x'], fetch_list=['bad'])
+    d003 = [d for d in res.errors if d.code == 'D003']
+    assert d003, res.render()
+    assert d003[0].op_type == 'mul'
+    assert 'x' in d003[0].message and 'W' in d003[0].message
+
+
+def test_declared_shape_conflict():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        # declares [-1, 9] but scale preserves [-1, 4]
+        out = blk.create_var(name='out', shape=[-1, 9], dtype='float32')
+        blk.append_op('scale', inputs={'X': x}, outputs={'Out': out},
+                      attrs={'scale': 2.0}, infer_shape=False)
+    res = prog.lint(feed_names=['x'], fetch_list=['out'])
+    d003 = [d for d in res.errors if d.code == 'D003']
+    assert d003 and d003[0].var == 'out'
+
+
+def test_unknown_op_d002_with_suggestion():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        out = blk.create_var(name='out', shape=[-1, 4], dtype='float32')
+        blk.append_op('sofmax', inputs={'X': x}, outputs={'Out': out},
+                      attrs={}, infer_shape=False)
+    res = prog.lint(feed_names=['x'], fetch_list=['out'])
+    d002 = [d for d in res if d.code == 'D002']
+    assert d002 and d002[0].severity == 'warning'
+    assert 'softmax' in (d002[0].fixit or '')
+
+
+def test_models_fully_covered_no_unknown_ops():
+    """Acceptance: the shape/dtype pass covers every op type used by the
+    bundled model programs — no D002, no shape errors."""
+    for name in ('mnist', 'stacked_lstm', 'word2vec'):
+        build = pt_lint._zoo_entry(name)
+        prog, feeds, fetches = build()
+        res = prog.lint(feed_names=feeds, fetch_list=fetches)
+        assert 'D002' not in _codes(res), (name, res.render())
+        assert not res.errors, (name, res.render())
+
+
+def test_int64_narrowing_d004():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        out = blk.create_var(name='c', shape=[1], dtype='int64')
+        blk.append_op('fill_constant', inputs={}, outputs={'Out': out},
+                      attrs={'shape': [1], 'value': 7, 'dtype': 'int64'},
+                      infer_shape=False)
+    res = prog.lint(fetch_list=['c'])
+    d004 = [d for d in res.infos if d.code == 'D004']
+    assert d004 and 'int64' in d004[0].message
+
+
+# --------------------------------------------- liveness (D005 / D006)
+
+def test_dead_op_and_unused_var():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        kept = layers.scale(x, scale=2.0)
+        layers.scale(x, scale=3.0)  # dead: never fetched, never read
+    res = prog.lint(feed_names=['x'], fetch_list=[kept])
+    assert 'D005' in _codes(res)
+    assert 'D006' in _codes(res)  # the dead op's output is unused too
+    dead = [d for d in res.warnings if d.code == 'D005']
+    assert dead[0].op_type == 'scale'
+
+
+def test_no_dead_ops_in_clean_program():
+    prog, _, m = _build_clean()
+    res = prog.lint(feed_names=['x', 'y'], fetch_list=[m['loss']])
+    assert 'D005' not in _codes(res), res.render()
+
+
+# ------------------------------------- donation/aliasing (D007-D009)
+
+def _param_writeback_program(read_after=True):
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        w = blk.create_parameter(name='W', shape=[4], dtype='float32')
+        post = blk.create_var(name='post', shape=[-1, 4], dtype='float32')
+        if not read_after:
+            blk.append_op('elementwise_add', inputs={'X': x, 'Y': w},
+                          outputs={'Out': post}, attrs={'axis': -1},
+                          infer_shape=False)
+        blk.append_op('assign', inputs={'X': x}, outputs={'Out': w},
+                      attrs={}, infer_shape=False)
+        if read_after:
+            blk.append_op('elementwise_add', inputs={'X': x, 'Y': w},
+                          outputs={'Out': post}, attrs={'axis': -1},
+                          infer_shape=False)
+    return prog
+
+
+def test_param_read_after_writeback_d007():
+    res = _param_writeback_program(True).lint(feed_names=['x'],
+                                              fetch_list=['post'])
+    d007 = [d for d in res.warnings if d.code == 'D007']
+    assert d007 and d007[0].var == 'W'
+    # reading before the writeback is the fine/normal ordering
+    res2 = _param_writeback_program(False).lint(feed_names=['x'],
+                                                fetch_list=['post'])
+    assert 'D007' not in _codes(res2)
+
+
+def test_feed_shadows_param_d008():
+    prog = _param_writeback_program(False)
+    res = prog.lint(feed_names=['x', 'W'], fetch_list=['post'])
+    d008 = [d for d in res.warnings if d.code == 'D008']
+    assert d008 and d008[0].var == 'W'
+
+
+def test_double_write_d009():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        s = blk.create_var(name='state', shape=[-1, 4], dtype='float32',
+                           persistable=True)
+        blk.append_op('assign', inputs={'X': x}, outputs={'Out': s},
+                      attrs={}, infer_shape=False)
+        blk.append_op('scale', inputs={'X': x}, outputs={'Out': s},
+                      attrs={'scale': 2.0}, infer_shape=False)
+    res = prog.lint(feed_names=['x'], fetch_list=['state'])
+    d009 = [d for d in res.warnings if d.code == 'D009']
+    assert d009 and d009[0].var == 'state'
+
+
+# ------------------------------------------- retrace hazards (D010/11)
+
+def test_unbucketed_seq_dim_d010_and_bucketer_coverage():
+    def build():
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            ids = layers.data('ids', shape=[-1], dtype='int64')  # [B, T]
+            emb = layers.embedding(ids, size=[100, 8])
+            loss = layers.mean(layers.reduce_sum(emb, dim=-1))
+        return prog, loss
+    prog, loss = build()
+    res = prog.lint(feed_names=['ids'], fetch_list=[loss])
+    seq = [d for d in res.warnings
+           if d.code == 'D010' and d.var == 'ids']
+    assert seq, res.render()
+    # a bucketer declaring ids as a sequence feed covers the hazard
+    b = fluid.FeedBucketer(mask_name='m', seq_names=('ids',))
+    res2 = prog.lint(feed_names=['ids'], fetch_list=[loss], bucketer=b)
+    assert not [d for d in res2.warnings
+                if d.code == 'D010' and d.var == 'ids'], res2.render()
+
+
+def test_array_attr_d011():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        blk = prog.global_block()
+        out = blk.create_var(name='v', shape=[4], dtype='float32')
+        blk.append_op('assign_value', inputs={}, outputs={'Out': out},
+                      attrs={'values': np.zeros(4, np.float32),
+                             'shape': [4]},
+                      infer_shape=False)
+    res = prog.lint(fetch_list=['v'])
+    assert [d for d in res.warnings if d.code == 'D011']
+
+
+# ------------------------------------------ numeric hazards (D012-14)
+
+def test_unclipped_log_d012_and_clipped_clean():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        raw = layers.log(x)
+        clipped = layers.log(layers.clip(x, min=1e-6, max=1e6))
+        loss = layers.mean(raw + clipped)
+    res = prog.lint(feed_names=['x'], fetch_list=[loss])
+    d012 = [d for d in res.warnings if d.code == 'D012'
+            and d.op_type == 'log']
+    assert len(d012) == 1, res.render()   # only the unclipped one
+
+
+def test_manual_softmax_d013_and_stabilized_clean():
+    def build(stabilized):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = layers.data('x', shape=[8], dtype='float32')
+            h = x
+            if stabilized:
+                h = layers.elementwise_sub(
+                    x, layers.reduce_max(x, dim=1, keep_dim=True))
+            e = layers.exp(h)
+            s = layers.reduce_sum(e, dim=1, keep_dim=True)
+            sm = layers.elementwise_div(e, s)
+            loss = layers.mean(sm)
+        return prog, loss
+    prog, loss = build(False)
+    res = prog.lint(feed_names=['x'], fetch_list=[loss])
+    assert [d for d in res.warnings if d.code == 'D013'], res.render()
+    prog2, loss2 = build(True)
+    res2 = prog2.lint(feed_names=['x'], fetch_list=[loss2])
+    assert 'D013' not in _codes(res2), res2.render()
+
+
+def test_degenerate_lr_decay_d014():
+    from paddle_tpu.layers import learning_rate_scheduler as lrs
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        lr = lrs.exponential_decay(0.1, decay_steps=100, decay_rate=1.5)
+    res = prog.lint(fetch_list=[lr])
+    d014 = [d for d in res.warnings if d.code == 'D014']
+    assert d014 and '1.5' in d014[0].message
+    # a sane schedule is clean
+    prog2 = fluid.Program()
+    with fluid.program_guard(prog2, fluid.Program()):
+        lr2 = lrs.exponential_decay(0.1, decay_steps=100, decay_rate=0.9)
+    assert 'D014' not in _codes(prog2.lint(fetch_list=[lr2]))
+
+
+# --------------------------------------------- executor PT_LINT hook
+
+def _broken_shape_program():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        blk = prog.global_block()
+        w = blk.create_parameter(name='W', shape=[3, 5], dtype='float32')
+        bad = blk.create_var(name='bad', shape=[-1, 5], dtype='float32')
+        blk.append_op('mul', inputs={'X': x, 'Y': w},
+                      outputs={'Out': bad}, attrs={}, infer_shape=False)
+    return prog
+
+
+def test_executor_strict_raises_build_time(monkeypatch):
+    monkeypatch.setenv('PT_LINT', 'strict')
+    prog = _broken_shape_program()
+    exe = fluid.Executor()
+    fluid.global_scope().set('W', np.zeros((3, 5), np.float32))
+    with pytest.raises(LintError) as ei:
+        exe.run(prog, feed={'x': np.zeros((2, 4), np.float32)},
+                fetch_list=['bad'])
+    assert 'mul' in str(ei.value)        # names the offending op
+    assert 'D003' in str(ei.value)
+
+
+def test_executor_lint_off_reproduces_raw_failure(monkeypatch):
+    monkeypatch.setenv('PT_LINT', '0')
+    prog = _broken_shape_program()
+    exe = fluid.Executor()
+    fluid.global_scope().set('W', np.zeros((3, 5), np.float32))
+    with pytest.raises(Exception) as ei:
+        exe.run(prog, feed={'x': np.zeros((2, 4), np.float32)},
+                fetch_list=['bad'])
+    assert not isinstance(ei.value, LintError)   # the raw mid-trace error
+
+
+def test_executor_warn_mode(monkeypatch):
+    monkeypatch.setenv('PT_LINT', 'warn')
+    prog = _broken_shape_program()
+    exe = fluid.Executor()
+    fluid.global_scope().set('W', np.zeros((3, 5), np.float32))
+    with pytest.warns(LintWarning, match='D003'):
+        with pytest.raises(Exception):
+            # lint only warns; the trace then fails raw
+            exe.run(prog, feed={'x': np.zeros((2, 4), np.float32)},
+                    fetch_list=['bad'])
+
+
+def test_executor_strict_clean_program_still_runs():
+    # default mode is strict; a healthy program lowers and runs
+    prog, start, m = _build_clean()
+    exe = fluid.Executor()
+    exe.run(start)
+    out = exe.run(prog,
+                  feed={'x': np.random.rand(4, 13).astype('float32'),
+                        'y': np.random.rand(4, 1).astype('float32')},
+                  fetch_list=[m['loss']])
+    assert np.isfinite(out[0]).all()
+    assert hasattr(prog, '_last_lint')
+    assert not prog._last_lint.errors
+
+
+# ------------------------------------------------- CLI + saved models
+
+def test_cli_saved_model_roundtrip(tmp_path, capsys):
+    prog, start, m = _build_clean()
+    exe = fluid.Executor()
+    exe.run(start)
+    with fluid.program_guard(prog, start):
+        fluid.save_inference_model(str(tmp_path), ['x'], [m['predict']],
+                                   exe, main_program=prog)
+    rc = pt_lint.main([str(tmp_path), '--json'])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    (label, res), = out['results'].items()
+    assert res['errors'] == 0
+
+
+def test_cli_fails_on_broken_saved_model(tmp_path, capsys):
+    import paddle_tpu.io as fluid_io
+    prog = _broken_shape_program()
+    desc = fluid_io.program_to_desc(prog)
+    desc['feed_names'] = ['x']
+    desc['fetch_names'] = ['bad']
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(os.path.join(str(tmp_path), '__model__.json'), 'w') as f:
+        json.dump(desc, f)
+    rc = pt_lint.main([str(tmp_path)])
+    assert rc == 2
+    assert 'D003' in capsys.readouterr().out
+
+
+def test_cli_builtin_gate_passes(capsys):
+    rc = pt_lint.main(['--builtin', 'fit_a_line', '--fail-on', 'error'])
+    assert rc == 0
+
+
+# ------------------------------------------------- rendering surfaces
+
+def test_source_loc_round_trips_through_desc():
+    import paddle_tpu.io as fluid_io
+    prog, _, m = _build_clean()
+    ops = prog.global_block().ops
+    assert any(op.source_loc for op in ops)
+    prog2 = fluid_io.desc_to_program(fluid_io.program_to_desc(prog))
+    ops2 = prog2.global_block().ops
+    assert any(getattr(op, 'source_loc', None) for op in ops2)
+
+
+def test_draw_graph_highlights_lint_findings(tmp_path):
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4], dtype='float32')
+        kept = layers.scale(x, scale=2.0)
+        layers.scale(x, scale=3.0)  # dead
+    from paddle_tpu.net_drawer import draw_graph
+    dot = draw_graph(None, prog, path=str(tmp_path / 'g.dot'),
+                     lint=True, feed_names=['x'], fetch_list=[kept])
+    assert 'orange' in dot and 'D005' in dot
+    assert (tmp_path / 'g.dot').exists()
+
+
+def test_lint_program_never_raises_on_pass_crash(monkeypatch):
+    from paddle_tpu.analysis import engine
+    # simulate an analyzer bug: a registered pass that explodes
+    engine._ensure_passes_loaded()
+    monkeypatch.setattr(engine, '_PASSES',
+                        engine._PASSES +
+                        [('boom', lambda ctx: 1 / 0)])
+    prog, _, m = _build_clean()
+    res = lint_program(prog, feed_names=('x', 'y'),
+                       fetch_names=(m['loss'].name,))
+    d099 = [d for d in res.infos if d.code == 'D099']
+    assert d099 and 'boom' in d099[0].message

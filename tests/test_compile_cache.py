@@ -18,7 +18,6 @@ import sys
 import warnings
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 import paddle_tpu.observability as obs

@@ -3,7 +3,6 @@ references (model: reference tests/unittests/test_warpctc_op.py,
 test_ctc_align_op.py, test_linear_chain_crf_op.py, test_crf_decoding_op.py,
 test_lstmp_op.py)."""
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
@@ -18,7 +17,6 @@ def np_ctc_nll(logits, labels, blank=0):
     T, C = logits.shape
     e = np.exp(logits - logits.max(-1, keepdims=True))
     probs = e / e.sum(-1, keepdims=True)
-    L = len(labels)
     ext = [blank]
     for l in labels:
         ext += [l, blank]

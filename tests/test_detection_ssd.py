@@ -31,7 +31,6 @@ def test_iou_similarity_numeric():
 
 
 def test_box_coder_encode_decode_roundtrip():
-    rng = np.random.RandomState(0)
     prior = np.array([[0., 0., 2., 2.], [1., 1., 4., 5.]], 'float32')
     pvar = np.array([[0.1, 0.1, 0.2, 0.2]] * 2, 'float32')
     tb = np.array([[0.5, 0.5, 2.5, 3.5], [0., 1., 3., 4.]], 'float32')

@@ -3,7 +3,6 @@ test_initializer.py): exact values for deterministic initializers,
 distribution statistics for random ones, fan-in/out scaling for
 Xavier/MSRA, the upsampling kernel for Bilinear."""
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers

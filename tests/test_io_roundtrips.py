@@ -2,7 +2,6 @@
 unittests): predicate-filtered save_vars, params vs persistables
 scope, cross-program load, single-file mode, checkpoint step."""
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers

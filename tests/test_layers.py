@@ -48,8 +48,8 @@ def test_concat_split_stack_gather():
     cat = layers.concat([x, y], axis=1)
     parts = layers.split(cat, 2, dim=1)
     st = layers.stack([x, y], axis=1)
-    idx = layers.data('idx', shape=[], dtype='int32',
-                      append_batch_size=False)
+    layers.data('idx', shape=[], dtype='int32',
+                append_batch_size=False)
     xv = np.ones((2, 4), 'float32')
     yv = np.zeros((2, 4), 'float32')
     res = _run([cat, parts[0], st], {'x': xv, 'y': yv})

@@ -106,7 +106,6 @@ def test_gqa_attention_equals_repeated_heads():
 
 
 def test_llama_tiny_converges():
-    rng = np.random.RandomState(0)
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         out = llama.build('tiny', lr=1e-3)

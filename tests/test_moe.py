@@ -14,7 +14,6 @@ def _reference_top2(x, params):
     """Loop reference: every token goes to its top-2 experts (no capacity
     drops), gates renormalized."""
     G, S, D = x.shape
-    E = params['wi'].shape[0]
     logits = np.einsum('gsd,de->gse', x, params['gate_w'])
     probs = np.exp(logits - logits.max(-1, keepdims=True))
     probs = probs / probs.sum(-1, keepdims=True)

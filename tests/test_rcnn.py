@@ -122,7 +122,6 @@ def test_rpn_target_assign_labels_and_targets():
 
 
 def test_generate_proposal_labels_classes():
-    rng = np.random.RandomState(3)
     N, R, G, B, C = 1, 12, 2, 6, 5
     gt_boxes = np.array([[[4, 4, 20, 20], [30, 30, 44, 44]]], 'float32')
     gt_cls = np.array([[[2], [4]]], 'int64')

@@ -195,7 +195,6 @@ def test_beam_search_finished_beam_propagates_end_id():
 def test_beam_search_decode_backtrace():
     from paddle_tpu.layers import control_flow as cf
     T, R = 3, 2
-    ids_feed = {}
     ids_arr = cf.create_array('int64')
     sc_arr = cf.create_array('float32')
     par_arr = cf.create_array('int32')

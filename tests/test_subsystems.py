@@ -4,7 +4,6 @@ checkpoint/resume, recordio conversion, async executor.
 import os
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import native

@@ -5,6 +5,29 @@
 set -u
 cd "$(dirname "$0")/.."
 
+echo "== ci_smoke: pt-lint over bundled models =="
+# static-analysis gate (docs/analysis.md): every bundled model program
+# must lint clean of error-severity findings (shape/dtype coverage of
+# every op type included — an unknown op is a warning, a shape error is
+# an error, and either class regressing shows up here)
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/pt_lint.py \
+    --all-builtin --fail-on error
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "ci_smoke: pt-lint FAILED (rc=$lint_rc)"
+fi
+
+echo "== ci_smoke: ruff =="
+# style/bug gate with the committed ruff.toml; the container image may
+# not ship ruff — skip with a notice rather than fail the smoke
+if command -v ruff >/dev/null 2>&1; then
+    ruff check paddle_tpu/ tests/ tools/
+    ruff_rc=$?
+else
+    echo "ci_smoke: ruff not installed; skipping lint step"
+    ruff_rc=0
+fi
+
 echo "== ci_smoke: tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -101,4 +124,5 @@ schema_rc=$?
 if [ "$t1_rc" -ne 0 ]; then
     echo "ci_smoke: tier-1 tests FAILED (rc=$t1_rc)"
 fi
-[ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
+    [ "$ruff_rc" -eq 0 ]

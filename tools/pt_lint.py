@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""pt-lint — static analysis CLI over paddle_tpu programs.
+
+Lints models saved via paddle_tpu.io (save_inference_model dirs) and the
+bundled model zoo, without compiling anything:
+
+    python tools/pt_lint.py path/to/saved_model_dir
+    python tools/pt_lint.py --builtin mnist --builtin transformer
+    python tools/pt_lint.py --all-builtin --min-severity warning
+    python tools/pt_lint.py model_dir --json
+
+Exit codes: 0 = no findings at/above --fail-on (default: error),
+2 = gated findings present, 1 = usage or load failure.
+
+docs/analysis.md documents the diagnostic codes and severities.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# lint must never touch an accelerator (and must run on CPU-only CI)
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+
+def _fluid():
+    import paddle_tpu as fluid
+    return fluid
+
+
+# --------------------------------------------------- bundled model zoo
+
+def _zoo_entry(name):
+    """name -> zero-arg builder returning (program, feed_names,
+    fetch_names).  Builders construct into a fresh program pair so CLI
+    invocations don't cross-contaminate the default program."""
+    fluid = _fluid()
+    import paddle_tpu.models as M
+
+    builders = {
+        'mnist': lambda: M.mnist.build(),
+        'resnet': lambda: M.resnet.build(),
+        'vgg': lambda: M.vgg.build(),
+        'se_resnext': lambda: M.se_resnext.build(),
+        'stacked_lstm': lambda: M.stacked_lstm.build(),
+        'transformer': lambda: M.transformer.build(),
+        'ctr_deepfm': lambda: M.ctr.deepfm(),
+        'ctr_wide_deep': lambda: M.ctr.wide_deep(),
+        'word2vec': lambda: M.word2vec.build(),
+        'fit_a_line': lambda: M.simple.fit_a_line(),
+        'recommender': lambda: M.simple.recommender(),
+        'llama': lambda: M.llama.build(),
+    }
+    if name not in builders:
+        raise KeyError('unknown builtin %r (have: %s)'
+                       % (name, ', '.join(sorted(builders))))
+
+    def build():
+        prog, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, start):
+            m = builders[name]()
+        feeds = [v.name for v in m.get('feeds', ())]
+        fetches = []
+        for key in ('loss', 'accuracy', 'predict'):
+            v = m.get(key)
+            if v is not None:
+                fetches.append(v.name)
+        for v in m.get('fetches', ()):
+            fetches.append(v if isinstance(v, str) else v.name)
+        return prog, feeds, fetches
+
+    return build
+
+
+def builtin_names():
+    return ['mnist', 'resnet', 'vgg', 'se_resnext', 'stacked_lstm',
+            'transformer', 'ctr_deepfm', 'ctr_wide_deep', 'word2vec',
+            'fit_a_line', 'recommender', 'llama']
+
+
+# --------------------------------------------------- saved-model loading
+
+def _load_saved(dirname, model_filename=None):
+    from paddle_tpu import io as fluid_io
+    path = os.path.join(dirname, model_filename or '__model__.json')
+    with open(path) as f:
+        desc = json.load(f)
+    program = fluid_io.desc_to_program(desc)
+    return (program, list(desc.get('feed_names', ())),
+            list(desc.get('fetch_names', ())))
+
+
+# --------------------------------------------------- linting + reporting
+
+def _lint_one(label, build_fn, args):
+    fluid = _fluid()
+    try:
+        program, feeds, fetches = build_fn()
+    except Exception as e:  # noqa: BLE001 - reported, exit 1
+        return label, None, 'load/build failed: %s' % e
+    bucketer = None
+    if args.seq_names or args.bucketed:
+        bucketer = fluid.FeedBucketer(mask_name='__mask__',
+                                      seq_names=args.seq_names or ())
+    result = program.lint(feed_names=feeds, fetch_list=fetches,
+                          bucketer=bucketer)
+    return label, result, None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog='pt-lint', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('model_dirs', nargs='*',
+                    help='saved-model dirs (paddle_tpu.io layout)')
+    ap.add_argument('--builtin', action='append', default=[],
+                    metavar='NAME',
+                    help='lint a bundled paddle_tpu.models program '
+                         '(repeatable); see --list-builtin')
+    ap.add_argument('--all-builtin', action='store_true',
+                    help='lint every bundled model program')
+    ap.add_argument('--list-builtin', action='store_true',
+                    help='print builtin names and exit')
+    ap.add_argument('--model-filename', default=None,
+                    help='program json inside a saved-model dir '
+                         '(default __model__.json)')
+    ap.add_argument('--min-severity', default='warning',
+                    choices=['info', 'warning', 'error'],
+                    help='lowest severity to PRINT (default warning)')
+    ap.add_argument('--fail-on', default='error',
+                    choices=['info', 'warning', 'error'],
+                    help='exit 2 when findings at/above this severity '
+                         'exist (default error)')
+    ap.add_argument('--json', action='store_true',
+                    help='emit one JSON object instead of text')
+    ap.add_argument('--seq-names', action='append', default=[],
+                    metavar='FEED',
+                    help='assume a FeedBucketer covering this sequence '
+                         'feed (repeatable; informs the retrace pass)')
+    ap.add_argument('--bucketed', action='store_true',
+                    help='assume a FeedBucketer pads the batch dim')
+    args = ap.parse_args(argv)
+
+    if args.list_builtin:
+        print('\n'.join(builtin_names()))
+        return 0
+
+    targets = []
+    for d in args.model_dirs:
+        targets.append((d, lambda d=d: _load_saved(
+            d, model_filename=args.model_filename)))
+    for name in (builtin_names() if args.all_builtin else args.builtin):
+        targets.append(('builtin:%s' % name, _zoo_entry(name)))
+    if not targets:
+        ap.error('nothing to lint: pass saved-model dirs, --builtin, '
+                 'or --all-builtin')
+
+    gated = 0
+    load_failed = 0
+    out = {}
+    for label, build_fn, in targets:
+        label, result, err = _lint_one(label, build_fn, args)
+        if err is not None:
+            load_failed += 1
+            if args.json:
+                out[label] = {'error': err}
+            else:
+                print('== %s\n  %s' % (label, err))
+            continue
+        gated += len(result.at_least(args.fail_on))
+        if args.json:
+            out[label] = result.to_dict()
+        else:
+            print('== %s' % label)
+            text = result.render(args.min_severity)
+            print('\n'.join('  ' + line for line in text.split('\n')))
+    if args.json:
+        print(json.dumps({'fail_on': args.fail_on, 'results': out},
+                         indent=2, sort_keys=True))
+    if load_failed:
+        return 1
+    return 2 if gated else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
