@@ -290,6 +290,15 @@ def main():
     def delta(name):
         return (snap1.get(name) or 0) - (snap0.get(name) or 0)
 
+    # PT_OPT rewriter accounting (core/passes): raw vs optimized traced-op
+    # counts for the headline program.  maybe_optimize is memoized per
+    # (program version, fetch set), so this reads the stats of the exact
+    # rewrite the executor lowered — no extra work.
+    from paddle_tpu.core import passes as pt_passes
+    raw_ops = sum(len(b.ops) for b in main_prog.blocks)
+    _, opt_stats = pt_passes.maybe_optimize(main_prog, (out['loss'].name,))
+    opt_ops = opt_stats['op_count_opt'] if opt_stats else raw_ops
+
     # the backend the bench process ACTUALLY ran on (the probe only says
     # what a subprocess saw) — a CPU fallback can't masquerade as TPU
     dev0 = jax.devices()[0]
@@ -312,6 +321,16 @@ def main():
         'compile_cache_misses': int(
             snap1.get('compile_cache.disk_misses') or 0),
         'tail_splits': int(snap1.get('executor.tail_splits') or 0),
+        # trace/compile split: Python tracing (what the PT_OPT rewriter
+        # shrinks) vs the XLA backend compile under it
+        'trace_s': round(snap1.get('executor.trace_s') or 0.0, 3),
+        'backend_compile_s': round(
+            snap1.get('executor.backend_compile_s') or 0.0, 3),
+        # program-rewriter telemetry (PT_OPT=1 default; docs/passes.md)
+        'program_op_count_raw': raw_ops,
+        'program_op_count_opt': opt_ops,
+        'opt_pass_ms': round(snap1.get('opt.pass_ms') or 0.0, 3),
+        'opt_ops_fused': int(snap1.get('opt.ops_fused') or 0),
         'stall_count': int(delta('executor.stall_count')),
         'prefetch_starvation_s': round(
             snap1.get('prefetch.starvation_s') or 0.0, 3),

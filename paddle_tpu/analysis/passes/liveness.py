@@ -7,62 +7,28 @@ sub-block boundary, or a side-effecting op.  Everything else is work XLA
 would DCE anyway — but silently, so the user never learns their fetch
 list is wrong or a head was left unwired.
 
+The walk itself lives in core/passes/walker.py, shared with the DCE
+REWRITE pass (core/passes/dce.py) so reporting and elimination can never
+drift apart.  This pass keeps `kill_overwrites=False`: a duplicate
+writer of a downstream-read name is D009's finding, not a dead op.
+
 The dead-op half needs a fetch set to anchor liveness; without one
 (e.g. linting a startup program) it is skipped and only the unused-var
 half runs.
 """
 from ...core.framework import Parameter
+from ...core.passes import walker
 from ..engine import register_pass
 
 __all__ = ['run']
 
-# ops that are alive regardless of dataflow (observable effects)
-_SIDE_EFFECT_OPS = {'print', 'py_func', '__backward__', 'write_to_array'}
-
-
-def _sub_block_reads(program, block_idx, seen=None):
-    """All var names read anywhere inside a sub-block tree — control-flow
-    bodies read outer vars straight from the lowering env, not through
-    the owning op's input slots, so they count as escaping uses."""
-    seen = set() if seen is None else seen
-    if block_idx in seen:
-        return set()
-    seen.add(block_idx)
-    reads = set()
-    for op in program.block(block_idx).ops:
-        reads |= set(op.input_names())
-        reads |= set(op.attrs.get('params', ()))
-        sub = op.attrs.get('sub_block')
-        if sub is not None:
-            reads |= _sub_block_reads(program, sub, seen)
-    return reads
+# re-exported: aliasing/retrace passes and tests import it from here
+_SIDE_EFFECT_OPS = walker.SIDE_EFFECT_OPS
 
 
 def _block_liveness(ctx, block, fetch_names, diags):
-    program = ctx.program
-    persistable = set()
-    for b in program.blocks:
-        persistable |= {n for n, v in b.vars.items()
-                        if v.persistable or isinstance(v, Parameter)}
-    # names read by sub-blocks anywhere below an op of this block count
-    # as escaping uses (the sub-block boundary)
-    needed = set(fetch_names)
-    alive = [False] * len(block.ops)
-    for i in range(len(block.ops) - 1, -1, -1):
-        op = block.ops[i]
-        outs = set(op.output_names())
-        is_alive = (bool(outs & needed) or
-                    bool(outs & persistable) or
-                    op.type in _SIDE_EFFECT_OPS or
-                    op.attrs.get('sub_block') is not None)
-        if is_alive:
-            alive[i] = True
-            needed |= set(op.input_names())
-            if op.type == '__backward__':
-                needed |= set(op.attrs.get('params', ()))
-            sub = op.attrs.get('sub_block')
-            if sub is not None:
-                needed |= _sub_block_reads(program, sub)
+    alive = walker.block_live_mask(ctx.program, block, fetch_names,
+                                   kill_overwrites=False)
     for i, op in enumerate(block.ops):
         if not alive[i]:
             diags.append(ctx.diag(
@@ -71,7 +37,8 @@ def _block_liveness(ctx, block, fetch_names, diags):
                 'persistable, or sub-block boundary'
                 % (op.type, sorted(op.output_names())),
                 block=block, op=op, op_index=i,
-                fixit='remove the op, or add its output to fetch_list',
+                fixit='remove the op, or add its output to fetch_list '
+                      '(the PT_OPT=1 rewriter removes it automatically)',
                 pass_name='liveness'))
 
 

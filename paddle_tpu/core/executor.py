@@ -15,6 +15,7 @@ import numpy as np
 
 from . import registry
 from . import compile_cache as _cc
+from . import passes as _passes
 from .framework import (Variable, default_main_program, TPUPlace,
                         Program)
 from .. import observability as _obs
@@ -177,6 +178,22 @@ def _amp_cast(x, to):
     return x
 
 
+def _amp_match_ins(op_type, ins):
+    """The elementwise-glue half of the AMP policy (see _AMP_MATCH): if
+    any float input is already bf16, cast the f32 ones down.  Shared by
+    the trace loop below and the fused_elementwise replay (ops/fused.py),
+    which must apply the identical policy per sub-op."""
+    import jax.numpy as jnp
+    if op_type not in _AMP_MATCH:
+        return ins
+    if not any(getattr(v, 'dtype', None) == jnp.bfloat16
+               for v in ins.values() if not isinstance(v, (list, tuple))):
+        return ins
+    return {s: (v if isinstance(v, (list, tuple))
+                else _amp_cast(v, jnp.bfloat16))
+            for s, v in ins.items()}
+
+
 def _exec_ops(ops, op_offset, env, ectx, program):
     """Trace a run of registered ops into `env` (the heart of lowering).
     Contiguous runs of ops sharing a recompute_id execute under
@@ -236,12 +253,8 @@ def _exec_ops_plain(ops, op_offset, env, ectx, program):
             if use_amp:
                 vals = [_amp_cast(v, jnp.bfloat16) for v in vals]
             ins[slot] = vals if op.input_is_list[slot] else vals[0]
-        if amp and op.type in _AMP_MATCH and any(
-                getattr(v, 'dtype', None) == jnp.bfloat16
-                for v in ins.values() if not isinstance(v, (list, tuple))):
-            ins = {s: (v if isinstance(v, (list, tuple))
-                       else _amp_cast(v, jnp.bfloat16))
-                   for s, v in ins.items()}
+        if amp:
+            ins = _amp_match_ins(op.type, ins)
         ctx = ectx.for_op(op_offset + i, op)
         if op.type in _REMAT_OPS:
             outs = jax.checkpoint(
@@ -345,7 +358,7 @@ def _launch_signature(program, feed_vals, feed_names, fetch_names, steps,
                                     type(feed_vals[n]).__name__))
                      for n in feed_names},
         fetch_set=fetch_names, steps=steps, check_nan=check_nan,
-        scope=scope._serial)
+        scope=scope._serial, opt=_passes.config_token())
 
 
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
@@ -372,10 +385,14 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
     # conflicts etc. fail at build with the op+var named, not mid-trace.
     # PT_LINT=strict (default) raises on error findings; =warn demotes
     # them to one LintWarning; =0 restores the raw mid-trace failures.
-    from ..analysis import apply_lint_policy, lint_mode
-    apply_lint_policy(program, feed_names=feed_names,
-                      fetch_names=fetch_names, mode=lint_mode(),
-                      header='program lint failed before lowering')
+    # An optimizer-produced twin (core/passes) skips the hook: its RAW
+    # original was already linted — gating on the rewritten program
+    # would let DCE delete a user's bug before strict mode could name it.
+    if not getattr(program, '_opt_of', False):
+        from ..analysis import apply_lint_policy, lint_mode
+        apply_lint_policy(program, feed_names=feed_names,
+                          fetch_names=fetch_names, mode=lint_mode(),
+                          header='program lint failed before lowering')
 
     block = program.global_block()
     ops = block.ops
@@ -394,7 +411,8 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
         # share one RNG stream by construction
         base_key = jax.random.fold_in(
             jax.random.key(program.random_seed), counter)
-        ectx = registry.ExecCtx(base_key, mesh=mesh)
+        ectx = registry.ExecCtx(base_key, mesh=mesh,
+                                amp=getattr(program, '_amp', False))
         env0 = {}
         env0.update(feeds)
         env0.update(params)
@@ -764,7 +782,8 @@ class Executor(object):
         return (id(program), program._version,
                 tuple((n,) + _feed_spec(feed_vals[n])
                       for n in sorted(feed_vals)),
-                fetch_names, self.check_nan, steps)
+                fetch_names, self.check_nan, steps,
+                _passes.config_token())
 
     def _gather_params(self, program, params_in, scope, base_key):
         import jax
@@ -808,10 +827,29 @@ class Executor(object):
             if entry is not None:
                 return entry, self._gather_params(program, entry.params_in,
                                                   scope, base_key)
+        # PT_LINT gate on the RAW program, BEFORE the rewriter: a user's
+        # def-use/shape bug must be named here, not DCE'd out of sight
+        from ..analysis import apply_lint_policy, lint_mode
+        apply_lint_policy(program, feed_names=feed_names,
+                          fetch_names=fetch_names, mode=lint_mode(),
+                          header='program lint failed before lowering')
+        # Program->Program rewriter (core/passes): the tracer sees the
+        # optimized twin; every cache key/RNG stream stays keyed on the
+        # RAW program (PT_OPT toggling is part of the hot key + launch
+        # signature via config_token, so it reads as a named change)
+        t_o0 = time.perf_counter() if obs_on else None
+        opt_program, opt_stats = _passes.maybe_optimize(program, fetch_names)
+        if obs_on and opt_stats is not None:
+            _obs.tracing.add_span(
+                'executor.optimize', t_o0, time.perf_counter(),
+                cat='compile',
+                args=dict(self._obs_tags,
+                          raw=opt_stats['op_count_raw'],
+                          opt=opt_stats['op_count_opt']) or None)
         t_l0 = time.perf_counter() if obs_on else None
         jit_fn, params_in, writeback = _lower(
-            program, feed_names, fetch_names, donate=True, mesh=self.mesh,
-            check_nan=self.check_nan, steps=steps)
+            opt_program, feed_names, fetch_names, donate=True,
+            mesh=self.mesh, check_nan=self.check_nan, steps=steps)
         if obs_on:
             _obs.metrics.counter('executor.lowerings').inc()
             _obs.tracing.add_span(
@@ -827,8 +865,12 @@ class Executor(object):
         call, fp, disk_tier = None, None, None
         if _cc.disk_enabled():
             _cc.ensure_xla_cache_backstop()
+            # fingerprint the OPTIMIZED desc: it is what actually lowers,
+            # and it folds the PT_OPT config in for free (PT_OPT=0 hashes
+            # the raw desc, a skipped pass changes the rewrite output)
             fp = _cc.launch_fingerprint(
-                program, {n: _feed_spec(feed_vals[n]) for n in feed_names},
+                opt_program,
+                {n: _feed_spec(feed_vals[n]) for n in feed_names},
                 fetch_names, steps, self.check_nan, mesh=self.mesh,
                 param_specs={n: _feed_spec(v) for n, v in params.items()})
             t_a0 = time.perf_counter()
@@ -855,8 +897,16 @@ class Executor(object):
             lowered = jit_fn.lower(params,
                                    {n: feed_vals[n] for n in feed_names},
                                    np.uint32(counter & 0xffffffff))
+            t_cmid = time.perf_counter()
             call = lowered.compile()
             t_c1 = time.perf_counter()
+            if obs_on:
+                # the trace/compile split: Python tracing (what PT_OPT
+                # shrinks) vs the XLA backend compile underneath it
+                _obs.metrics.counter('executor.trace_s').inc(
+                    t_cmid - t_c0)
+                _obs.metrics.counter('executor.backend_compile_s').inc(
+                    t_c1 - t_cmid)
             if obs_on and _TRACE_COUNT[0] > tc0:
                 sig = _launch_signature(program, feed_vals, feed_names,
                                         fetch_names, steps, self.check_nan,
@@ -877,7 +927,7 @@ class Executor(object):
                 tier = _cc.disk_cache().store(
                     fp, compiled=call, lowered=lowered,
                     meta={'steps': steps, 'fetch': list(fetch_names),
-                          'program': _cc.program_fingerprint(program)})
+                          'program': _cc.program_fingerprint(opt_program)})
                 if tier and obs_on:
                     _obs.metrics.counter('compile_cache.store_s').inc(
                         time.perf_counter() - t_s0)

@@ -735,6 +735,13 @@ class Program(object):
                                   persistable=v.persistable,
                                   stop_gradient=v.stop_gradient,
                                   is_data=v.is_data, type=v.type)
+                # side-channel markers the lowering reads via getattr:
+                # tensor-array vars (control_flow_exec) and ragged-length
+                # companions (sequence layers)
+                if getattr(v, 'is_tensor_array', False):
+                    nv.is_tensor_array = True
+                if getattr(v, 'lod_length_name', None):
+                    nv.lod_length_name = v.lod_length_name
                 nb.vars[name] = nv
             for op in b.ops:
                 role = op.attrs.get('op_role', OpRole.Forward)
@@ -786,7 +793,7 @@ class Program(object):
         return p
 
     def lint(self, feed_names=(), fetch_list=(), bucketer=None,
-             passes=None):
+             passes=None, optimize=False):
         """Static analysis without compiling: run the paddle_tpu.analysis
         passes (def-use, shape/dtype abstract interpretation, dead ops,
         donation conflicts, retrace hazards, numerical hazards) and
@@ -796,12 +803,24 @@ class Program(object):
         fetch_list anchors the dead-op pass; bucketer (a
         data_feeder.FeedBucketer) tells the retrace pass which dynamic
         feed dims are already padded onto stable bucket signatures.
+
+        optimize=True first runs the PT_OPT rewriter pipeline
+        (core/passes, honoring PT_OPT_SKIP) and lints the OPTIMIZED
+        program — what the executor actually traces under PT_OPT=1.
+        Diagnostics still point at model `source_loc` (folded/fused ops
+        inherit their originals').  Default False so findings the
+        rewriter would fix (dead ops, 64-bit attrs) stay visible when
+        linting the program as written.
         """
         from ..analysis import lint_program
         fetch_names = []
         for f in (fetch_list or ()):
             fetch_names.append(f.name if isinstance(f, Variable) else f)
-        return lint_program(self, feed_names=tuple(feed_names),
+        target = self
+        if optimize:
+            from .passes import optimize_program
+            target, _ = optimize_program(self, tuple(fetch_names))
+        return lint_program(target, feed_names=tuple(feed_names),
                             fetch_names=tuple(fetch_names),
                             bucketer=bucketer, passes=passes)
 

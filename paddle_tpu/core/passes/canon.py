@@ -1,0 +1,132 @@
+"""Attr canonicalization: make the program desc say what the lowering
+will actually do.
+
+(1) 64-bit dtype attrs narrow to their 32-bit twins when jax runs with
+x64 disabled (the default) — the kernels already materialize through
+``dtypes.jax_dtype``, which truncates identically, so this is purely
+descriptive: it removes the D004 lint hazard and makes the desc
+fingerprint match runtime semantics.  With x64 enabled nothing narrows.
+
+(2) Initializer dedup across blocks: a sub-block ``fill_constant`` /
+``fill_zeros_like``-free constant identical to one already produced in
+an ancestor block (same attrs, producer not rebound) rewrites to an
+``assign`` of the ancestor's var — the constant materializes once per
+program instead of once per control-flow body, and `assign` traces to
+nothing.
+"""
+import json
+
+import numpy as np
+
+__all__ = ['run']
+
+_DTYPE_ATTRS = ('dtype', 'in_dtype', 'out_dtype')
+_NARROW = {'int64': 'int32', 'uint64': 'uint32', 'float64': 'float32',
+           'complex128': 'complex64'}
+
+
+def _narrow_attrs(program, stats):
+    import jax
+    if jax.config.jax_enable_x64:
+        return
+    for block in program.blocks:
+        for op in block.ops:
+            attr_dicts = [op.attrs]
+            # fused sub-programs carry their own attr dicts
+            for sub in op.attrs.get('sub_ops') or ():
+                attr_dicts.append(sub['attrs'])
+            for attrs in attr_dicts:
+                for key in _DTYPE_ATTRS:
+                    v = attrs.get(key)
+                    name = v if isinstance(v, str) else (
+                        np.dtype(v).name if v is not None else None)
+                    if name in _NARROW:
+                        attrs[key] = _NARROW[name]
+                        stats['attrs_narrowed'] += 1
+                        program._bump()
+                # np scalar attrs: normalize so desc json and attr
+                # hashing are width-stable
+                for k, v in list(attrs.items()):
+                    if isinstance(v, np.integer):
+                        attrs[k] = int(v)
+                    elif isinstance(v, np.floating):
+                        attrs[k] = float(v)
+
+
+def _const_key(op):
+    if op.type != 'fill_constant' or op.inputs:
+        return None
+    return json.dumps(
+        {k: v for k, v in op.attrs.items()
+         if k in ('shape', 'value', 'dtype')},
+        sort_keys=True, default=str)
+
+
+def _root_owner_index(program):
+    """block idx -> index (in the ROOT block) of the op whose sub-block
+    tree contains it; None for the root block or unowned blocks."""
+    owner = {}  # sub idx -> (owning block idx, op index)
+    for b in program.blocks:
+        for i, op in enumerate(b.ops):
+            sub = op.attrs.get('sub_block')
+            if sub is not None:
+                owner[sub] = (b.idx, i)
+    result = {}
+    for idx in range(1, len(program.blocks)):
+        cur, hops = idx, 0
+        while cur in owner and owner[cur][0] != 0 and hops < 64:
+            cur = owner[cur][0]
+            hops += 1
+        result[idx] = owner[cur][1] if cur in owner else None
+    return result
+
+
+def _dedupe_initializers(program, ctx, stats):
+    if len(program.blocks) < 2:
+        return
+    root = program.blocks[0]
+    root_owner = _root_owner_index(program)
+    # root-block constants, keyed by attrs, with their producer position:
+    # a sub-block may only reuse a constant produced BEFORE its owning op
+    by_key = {}
+    for i, op in enumerate(root.ops):
+        key = _const_key(op)
+        if key is None:
+            continue
+        out = op.output_names()
+        if len(out) == 1 and out[0] not in ctx.multi_written and \
+                out[0] not in ctx.persistable:
+            by_key.setdefault(key, (i, out[0]))
+    if not by_key:
+        return
+    for block in program.blocks[1:]:
+        limit = root_owner.get(block.idx)
+        if limit is None:
+            continue
+        for op in block.ops:
+            key = _const_key(op)
+            if key is None:
+                continue
+            out = op.output_names()
+            if len(out) != 1 or out[0] in ctx.multi_written or \
+                    out[0] in ctx.persistable or out[0] in ctx.cf_pinned:
+                continue
+            hit = by_key.get(key)
+            if hit is None or hit[0] >= limit or hit[1] == out[0]:
+                continue
+            src = hit[1]
+            op.type = 'assign'
+            op.inputs = {'X': [src]}
+            op.input_is_list = {'X': False}
+            op.attrs = {k: op.attrs[k] for k in ('op_role', 'rng_stream',
+                                                 'recompute_id')
+                        if k in op.attrs}
+            stats['initializers_deduped'] += 1
+            program._bump()
+
+
+def run(program, ctx):
+    stats = {'attrs_narrowed': 0, 'initializers_deduped': 0}
+    _narrow_attrs(program, stats)
+    _dedupe_initializers(program, ctx, stats)
+    return stats

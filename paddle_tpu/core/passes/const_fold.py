@@ -1,0 +1,139 @@
+"""Constant folding: collapse compile-time-constant op chains into single
+``fill_constant`` ops.
+
+`fill_constant` (and `assign` of one) seeds the constant environment;
+whitelisted pure elementwise ops whose inputs are ALL known constants are
+evaluated at optimize time **via the op's own registered kernel** — the
+same jnp code the trace would run, on the same backend, so the folded
+value is dtype-exact (fill_constant materializes through
+``dtypes.jax_dtype``, exactly like the runtime does).  Only uniform
+results fold (a fill_constant can represent nothing else); elementwise
+ops of uniform inputs are uniform by construction, the check is a
+belt-and-braces guard.
+
+The classic win is LR-schedule and loss-scaling glue built from Python
+scalars: ``fill_constant -> scale -> elementwise_pow`` chains become one
+op, and the orphaned producers are swept by the DCE helper.
+"""
+import numpy as np
+
+from . import dce
+
+__all__ = ['run', 'FOLDABLE_OPS']
+
+# pure ops safe to evaluate on host at optimize time (no rng, no shape
+# surprises, uniform-in -> uniform-out)
+FOLDABLE_OPS = {
+    'scale', 'cast', 'elementwise_add', 'elementwise_sub',
+    'elementwise_mul', 'elementwise_div', 'elementwise_pow',
+    'elementwise_max', 'elementwise_min', 'sqrt', 'rsqrt', 'abs',
+    'square', 'sign', 'floor', 'ceil', 'round', 'reciprocal', 'exp',
+    'log', 'clip', 'pow', 'sigmoid', 'tanh', 'relu',
+}
+
+# don't materialize huge arrays on host just to prove them uniform
+_MAX_FOLD_ELEMS = 1 << 16
+
+
+class _FoldCtx(object):
+    """Minimal exec ctx for host evaluation: foldable ops use no rng."""
+    is_infer = False
+    mesh = None
+    amp = False
+
+
+def _const_value(op):
+    """(value, shape, dtype) when `op` is a representable constant."""
+    if op.type != 'fill_constant':
+        return None
+    shape = [int(d) for d in op.attrs.get('shape', [])]
+    if any(d < 0 for d in shape):
+        return None
+    return (op.attrs.get('value', 0.0), tuple(shape),
+            op.attrs.get('dtype', 'float32'))
+
+
+def _materialize(const):
+    import jax.numpy as jnp
+    from ..dtypes import jax_dtype
+    value, shape, dtype = const
+    return jnp.full(shape, value, dtype=jax_dtype(dtype))
+
+
+def _eval_op(op, const_env):
+    """Run the op's kernel on the materialized constant inputs; returns
+    the folded (value, shape, dtype) or None when the result can't be a
+    fill_constant."""
+    from .. import registry
+    impl = registry.get_op(op.type).impl
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = [_materialize(const_env[n]) for n in names]
+        if any(np.prod(v.shape or (1,)) > _MAX_FOLD_ELEMS for v in vals):
+            return None
+        ins[slot] = vals if op.input_is_list[slot] else vals[0]
+    try:
+        outs = impl(_FoldCtx(), ins, op.attrs)
+    except Exception:  # noqa: BLE001 - give up, leave the op in place
+        return None
+    out = outs.get('Out')
+    if out is None or isinstance(out, (list, tuple)):
+        return None
+    arr = np.asarray(out)
+    if arr.size == 0 or arr.size > _MAX_FOLD_ELEMS:
+        return None
+    first = arr.ravel()[0]
+    if not np.all(arr == first):  # NaN never folds (NaN != NaN): fine
+        return None
+    return (first.item(), tuple(int(d) for d in arr.shape),
+            str(arr.dtype) if arr.dtype.names is None else None)
+
+
+def run(program, ctx):
+    from .. import registry
+    stats = {'ops_folded': 0, 'ops_removed': 0}
+    multi = ctx.multi_written
+    for block in program.blocks:
+        const_env = {}
+        for op in block.ops:
+            outs = op.output_names()
+            if any(n in multi for n in outs) or \
+                    any(n in multi for n in op.input_names()):
+                continue
+            if any(n in ctx.persistable or n in ctx.cf_pinned
+                   for n in outs):
+                continue
+            c = _const_value(op)
+            if c is not None:
+                const_env[outs[0]] = c
+                continue
+            if op.type == 'assign' and op.input_names() and \
+                    op.input_names()[0] in const_env and len(outs) == 1:
+                folded = const_env[op.input_names()[0]]
+            elif (op.type in FOLDABLE_OPS and len(outs) == 1 and
+                    registry.has_op(op.type) and op.input_names() and
+                    all(n in const_env for n in op.input_names())):
+                folded = _eval_op(op, const_env)
+            else:
+                continue
+            if folded is None or folded[2] is None:
+                continue
+            value, shape, dtype = folded
+            # rewrite IN PLACE into the single equivalent fill_constant;
+            # source_loc and the output binding survive untouched
+            op.type = 'fill_constant'
+            op.inputs = {}
+            op.input_is_list = {}
+            keep = {k: op.attrs[k] for k in ('op_role', 'recompute_id',
+                                             'rng_stream')
+                    if k in op.attrs}
+            op.attrs = dict(keep, shape=list(shape), value=value,
+                            dtype=dtype)
+            const_env[outs[0]] = (value, tuple(shape), dtype)
+            stats['ops_folded'] += 1
+            program._bump()
+    if stats['ops_folded']:
+        # producers a folded chain no longer reads are now dead
+        dce.sweep_dead(program, ctx.fetch_names, stats,
+                       pinned=ctx.cf_pinned)
+    return stats

@@ -1,0 +1,134 @@
+"""Shared Program-IR reachability machinery.
+
+One liveness walker serves two consumers with different stakes:
+
+  * the analysis D005/D006 pass (analysis/passes/liveness.py) REPORTS
+    dead ops and must match what a user reading the program would call
+    dead (no overwrite-kill subtlety: a duplicate writer is D009's
+    domain, not D005's), and
+  * the DCE rewrite pass (core/passes/dce.py) REMOVES ops and wants the
+    sharper classic-liveness rule: a write that is overwritten before
+    any read is dead even though the name itself is live downstream.
+
+Both walk the same sub-block read closure — control-flow bodies read
+outer vars straight from the lowering env, not through the owning op's
+input slots, so those names count as escaping uses — and pin the same
+side-effect op set.  `kill_overwrites` selects the rule.
+"""
+
+__all__ = ['SIDE_EFFECT_OPS', 'sub_block_reads', 'persistable_names',
+           'block_live_mask', 'control_flow_pinned']
+
+# ops that are alive regardless of dataflow (observable effects)
+SIDE_EFFECT_OPS = {'print', 'py_func', '__backward__', 'write_to_array'}
+
+
+def control_flow_pinned(program):
+    """Names the control-flow lowerer pattern-matches on, closed over
+    their producer chains.
+
+    control_flow_exec reads the IR structurally: `_static_bound` walks
+    ``cond.op`` expecting a literal ``less_than(i, fill_constant)``
+    chain, and while/recurrent bodies exchange values with the parent by
+    NAME through attr lists (update_vars, out_vars, Condition, ...).  A
+    rewrite that hides any of these producers inside a fused op (or
+    rebinds/merges them) breaks loop lowering — so every rewrite pass
+    leaves ops producing pinned names exactly as they are.
+
+    Seeds: all inputs of native control-flow ops plus every string (or
+    list-of-strings) attr they carry — attr values that aren't var names
+    pin nothing and cost nothing.  The closure then walks producers
+    backward so e.g. the fill_constant feeding a loop-bound less_than
+    stays visible too.
+    """
+    from ..control_flow_exec import NATIVE_OPS
+    pinned = set()
+    for b in program.blocks:
+        for op in b.ops:
+            if op.type not in NATIVE_OPS and \
+                    op.attrs.get('sub_block') is None:
+                continue
+            pinned |= set(op.input_names())
+            for v in op.attrs.values():
+                if isinstance(v, str):
+                    pinned.add(v)
+                elif isinstance(v, (list, tuple)):
+                    pinned |= {e for e in v if isinstance(e, str)}
+    if not pinned:
+        return pinned
+    changed = True
+    while changed:
+        changed = False
+        for b in program.blocks:
+            for op in reversed(b.ops):
+                if set(op.output_names()) & pinned:
+                    ins = set(op.input_names())
+                    if not ins <= pinned:
+                        pinned |= ins
+                        changed = True
+    return pinned
+
+
+def sub_block_reads(program, block_idx, seen=None):
+    """All var names read anywhere inside a sub-block tree, including
+    `__backward__` differentiation targets (attrs['params'])."""
+    seen = set() if seen is None else seen
+    if block_idx in seen:
+        return set()
+    seen.add(block_idx)
+    reads = set()
+    for op in program.block(block_idx).ops:
+        reads |= set(op.input_names())
+        reads |= set(op.attrs.get('params', ()))
+        sub = op.attrs.get('sub_block')
+        if sub is not None:
+            reads |= sub_block_reads(program, sub, seen)
+    return reads
+
+
+def persistable_names(program):
+    """Every persistable (incl. Parameter) name, program-wide."""
+    from ..framework import Parameter
+    names = set()
+    for b in program.blocks:
+        names |= {n for n, v in b.vars.items()
+                  if v.persistable or isinstance(v, Parameter)}
+    return names
+
+
+def block_live_mask(program, block, root_names, persistable=None,
+                    kill_overwrites=False):
+    """Reverse liveness walk over one block's ops.
+
+    Returns a list of booleans parallel to ``block.ops``: True = alive.
+    An op is alive when any output (transitively) reaches a root name, a
+    persistable write, a sub-block boundary, or a side-effecting op.
+
+    kill_overwrites=False (analysis reporting): a name stays needed even
+    across an intervening full write, so every writer of a downstream-
+    read name counts as alive.
+    kill_overwrites=True (DCE rewriting): a write KILLS the need above
+    it — an earlier write that is overwritten before any read is dead.
+    """
+    if persistable is None:
+        persistable = persistable_names(program)
+    needed = set(root_names)
+    alive = [False] * len(block.ops)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        outs = set(op.output_names())
+        is_alive = (bool(outs & needed) or
+                    bool(outs & persistable) or
+                    op.type in SIDE_EFFECT_OPS or
+                    op.attrs.get('sub_block') is not None)
+        if is_alive:
+            alive[i] = True
+            if kill_overwrites:
+                needed -= outs
+            needed |= set(op.input_names())
+            if op.type == '__backward__':
+                needed |= set(op.attrs.get('params', ()))
+            sub = op.attrs.get('sub_block')
+            if sub is not None:
+                needed |= sub_block_reads(program, sub)
+    return alive

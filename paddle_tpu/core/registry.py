@@ -74,6 +74,7 @@ class InferCtx(object):
 
     is_infer = True
     mesh = None
+    amp = False
 
     def __init__(self, op=None):
         self.op = op
@@ -86,16 +87,29 @@ class InferCtx(object):
 class ExecCtx(object):
     """Per-run context shared by all ops in one lowered block.  `mesh` is
     the executor's device mesh (None single-chip): mesh-aware ops like
-    ring_attention pick their collective strategy from it."""
+    ring_attention pick their collective strategy from it.  `amp` is the
+    program's bf16 mixed-precision flag — the fused_elementwise kernel
+    replays the executor's per-op AMP policy and needs it in-band."""
 
     is_infer = False
 
-    def __init__(self, base_key, mesh=None):
+    def __init__(self, base_key, mesh=None, amp=False):
         self.base_key = base_key
         self.mesh = mesh
+        self.amp = amp
 
     def for_op(self, op_index, op):
         return OpCtx(self, op_index, op)
+
+
+class _SubOpShim(object):
+    """Op stand-in for one serialized sub-op of a fused_elementwise op —
+    just enough surface (type, attrs) for OpCtx to derive RNG streams."""
+    __slots__ = ('type', 'attrs')
+
+    def __init__(self, type, attrs):
+        self.type = type
+        self.attrs = attrs
 
 
 class OpCtx(object):
@@ -110,10 +124,24 @@ class OpCtx(object):
     def mesh(self):
         return self._exec.mesh
 
+    @property
+    def amp(self):
+        return self._exec.amp
+
     def rng(self, n=0):
         # op streams are 1-based: stream 0 off the run key is reserved for
         # the executor itself (the run key is already one fold deep — the
         # run counter is folded into the program key — so op draws must
-        # never collide with a bare counter fold)
+        # never collide with a bare counter fold).  An optimized program
+        # pins each op's ORIGINAL position in an `rng_stream` attr (see
+        # core/passes) so rewrites never shift RNG streams.
+        idx = self.op.attrs.get('rng_stream')
+        if idx is None:
+            idx = self.op_index
         return jax.random.fold_in(self._exec.base_key,
-                                  (self.op_index + 1) * 1009 + n)
+                                  (idx + 1) * 1009 + n)
+
+    def sub_ctx(self, sub_desc):
+        """Context for one replayed sub-op of a fused_elementwise op."""
+        return OpCtx(self._exec, self.op_index,
+                     _SubOpShim(sub_desc['type'], sub_desc['attrs']))

@@ -73,9 +73,22 @@ class Predictor(object):
         self._fetch_names = [v.name for v in fetch_vars]
         if config.use_bf16:
             self._cast_params_bf16()
+        # PT_OPT rewriter (core/passes): serving traces the optimized
+        # twin too; lint policy stays anchored on the raw program, which
+        # _lower checks when the rewriter is disabled
+        from .core import passes as _passes
+        if _passes.enabled():
+            from .analysis import apply_lint_policy, lint_mode
+            apply_lint_policy(self._program,
+                              feed_names=tuple(self._feed_names),
+                              fetch_names=tuple(self._fetch_names),
+                              mode=lint_mode(),
+                              header='program lint failed before lowering')
+        opt_program, _ = _passes.maybe_optimize(
+            self._program, tuple(self._fetch_names))
         # one lowering; the jitted fn re-specializes per feed shape itself
         self._fn, self._params_in, _ = _lower(
-            self._program, tuple(self._feed_names),
+            opt_program, tuple(self._feed_names),
             tuple(self._fetch_names), donate=False)
         # per-shape AOT executables, warm-started from the persistent
         # cache (core/compile_cache.py) when PT_CACHE is on: a freshly

@@ -26,16 +26,19 @@ from . import tracing
 __all__ = ['LaunchSignature', 'RetraceExplainer', 'explainer', 'reset']
 
 _COMPONENTS = ('program', 'feed_shapes', 'feed_dtypes', 'fetch_set',
-               'steps', 'check_nan', 'scope')
+               'steps', 'check_nan', 'scope', 'opt')
 
 
 class LaunchSignature(object):
     """Structured cache key: one attribute per component the executor's
-    lowering cache (and jax.jit underneath it) keys on."""
+    lowering cache (and jax.jit underneath it) keys on.  `opt` is the
+    program-rewriter config token (core/passes.config_token()): toggling
+    PT_OPT / PT_OPT_SKIP mid-process changes what the tracer sees for the
+    same raw program, and must be named, not a mystery retrace."""
     __slots__ = _COMPONENTS
 
     def __init__(self, program, feed_shapes, feed_dtypes, fetch_set,
-                 steps, check_nan, scope):
+                 steps, check_nan, scope, opt=None):
         self.program = program            # (serial, version)
         self.feed_shapes = dict(feed_shapes)   # name -> tuple
         self.feed_dtypes = dict(feed_dtypes)   # name -> str
@@ -43,6 +46,7 @@ class LaunchSignature(object):
         self.steps = steps
         self.check_nan = bool(check_nan)
         self.scope = scope
+        self.opt = opt
 
     def changed_components(self, other):
         return [c for c in _COMPONENTS
@@ -81,6 +85,9 @@ class LaunchSignature(object):
         if self.scope != other.scope:
             details.append('scope: serial %r -> %r'
                            % (other.scope, self.scope))
+        if self.opt != other.opt:
+            details.append('opt: PT_OPT config %r -> %r (program rewriter '
+                           'toggled/reconfigured)' % (other.opt, self.opt))
         return details
 
 

@@ -16,3 +16,4 @@ from . import detection  # noqa
 from . import attention  # noqa
 from . import ctc_crf  # noqa
 from . import int8  # noqa
+from . import fused  # noqa  (fused_elementwise from core/passes/fuse.py)

@@ -141,8 +141,8 @@ def sequence_unpad(ctx, ins, attrs):
 def sequence_mask(ctx, ins, attrs):
     x = ins['X']  # lengths tensor
     maxlen = attrs.get('maxlen', -1)
-    from ..core.dtypes import convert_dtype
-    dtype = convert_dtype(attrs.get('out_dtype', 'int64'))
+    from ..core.dtypes import jax_dtype
+    dtype = jax_dtype(attrs.get('out_dtype', 'int64'))
     if maxlen is None or maxlen < 0:
         raise ValueError('sequence_mask on TPU requires static maxlen attr')
     m = jnp.arange(maxlen)[None, :] < x.reshape(-1, 1)

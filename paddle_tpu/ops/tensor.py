@@ -467,7 +467,9 @@ def uniform_random_batch_size_like(ctx, ins, attrs):
     shape = list(attrs['shape'])
     shape[attrs.get('output_dim_idx', 0)] = \
         ref.shape[attrs.get('input_dim_idx', 0)]
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    # jax_dtype, not convert_dtype: the astype happens INSIDE the trace,
+    # and asking for a 64-bit dtype there warn-and-truncates per trace
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
     key = ctx.rng()
     return {'Out': jax.random.uniform(
         key, shape, dtype=jnp.float32,
@@ -481,7 +483,7 @@ def gaussian_random_batch_size_like(ctx, ins, attrs):
     shape = list(attrs['shape'])
     shape[attrs.get('output_dim_idx', 0)] = \
         ref.shape[attrs.get('input_dim_idx', 0)]
-    dtype = convert_dtype(attrs.get('dtype', 'float32'))
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))  # in-trace astype
     key = ctx.rng()
     out = attrs.get('mean', 0.0) + attrs.get('std', 1.0) * \
         jax.random.normal(key, shape, dtype=jnp.float32)

@@ -285,21 +285,42 @@ def test_predictor_warm_starts_from_disk(tmp_path, monkeypatch):
 
 def test_int64_sites_stay_silent():
     """fill_constant / astype / cast asked for int64 route through
-    core.dtypes.jax_dtype — no warn-and-truncate from jax may fire."""
+    core.dtypes.jax_dtype — no warn-and-truncate from jax may fire.
+
+    Covers both BENCH_r05-tail leak sites: the `jnp.full` inside
+    fill_constant (ops/tensor.py) and the in-trace `.astype` path (the
+    *_batch_size_like random ops went through convert_dtype, whose
+    int64 survives to `.astype` inside the trace).  Runs under BOTH
+    PT_OPT settings so the const-fold/fusion replay paths are pinned
+    silent too."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         with fluid.unique_name.guard():
             x = fluid.layers.data('x', shape=[4], dtype='float32')
             c = fluid.layers.fill_constant([2, 2], 'int64', 7)
+            c2 = fluid.layers.cast(c, 'int64') + 1  # fold+fuse fodder
             casted = x.astype('int64')
             topv, topi = fluid.layers.topk(x, k=2)
-    exe, scope = fluid.Executor(), fluid.Scope()
-    with warnings.catch_warnings():
-        warnings.simplefilter('error', UserWarning)
-        with fluid.scope_guard(scope):
-            exe.run(startup)
-            cv, iv, tv = exe.run(
-                main, feed={'x': np.ones((3, 4), 'float32')},
-                fetch_list=[c, casted, topi])
-    assert cv.ravel()[0] == 7
-    assert iv.dtype.kind == 'i' and tv.dtype.kind == 'i'
+            blk = main.global_block()
+            rnd = blk.create_var(dtype='int64', shape=(-1, 4))
+            blk.append_op(
+                type='uniform_random_batch_size_like',
+                inputs={'Input': x}, outputs={'Out': rnd},
+                attrs={'shape': [-1, 4], 'dtype': 'int64',
+                       'min': 0.0, 'max': 9.0})
+    for pt_opt in ('1', '0'):
+        os.environ['PT_OPT'] = pt_opt
+        try:
+            exe, scope = fluid.Executor(), fluid.Scope()
+            with warnings.catch_warnings():
+                warnings.simplefilter('error', UserWarning)
+                with fluid.scope_guard(scope):
+                    exe.run(startup)
+                    cv, c2v, iv, tv, rv = exe.run(
+                        main, feed={'x': np.ones((3, 4), 'float32')},
+                        fetch_list=[c, c2, topi, casted, rnd])
+        finally:
+            os.environ.pop('PT_OPT', None)
+        assert cv.ravel()[0] == 7 and c2v.ravel()[0] == 8
+        assert iv.dtype.kind == 'i' and tv.dtype.kind == 'i'
+        assert rv.dtype.kind == 'i' and rv.shape == (3, 4)

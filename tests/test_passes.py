@@ -1,0 +1,468 @@
+"""Program-IR optimizing rewriter (core/passes): per-pass unit tests,
+pipeline idempotence, PT_OPT/PT_OPT_SKIP env plumbing, bitwise training
+parity vs the unoptimized lowering (run / run_steps / ParallelExecutor),
+and saved-model round-trips of optimized programs."""
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import passes
+
+
+def _op_types(program):
+    return [op.type for b in program.blocks for op in b.ops]
+
+
+def _op_count(program):
+    return sum(len(b.ops) for b in program.blocks)
+
+
+# ------------------------------------------------------------------ dce
+
+def test_dce_removes_dead_chain_keeps_live():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        live = fluid.layers.scale(x, scale=2.0)
+        dead = fluid.layers.scale(x, scale=3.0)
+        dead2 = fluid.layers.scale(dead, scale=4.0)  # noqa: F841
+    opt, stats = passes.optimize_program(main, (live.name,),
+                                         skip={'fuse_elementwise'})
+    assert stats['passes']['dce']['ops_removed'] == 2
+    assert _op_types(opt) == ['scale']
+
+
+def test_dce_keeps_persistable_writes_and_side_effects():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.scale(x, scale=2.0)
+        blk = main.global_block()
+        p = blk.create_var(name='pstate', shape=(4,), dtype='float32',
+                           persistable=True)
+        blk.append_op(type='scale', inputs={'X': x}, outputs={'Out': p},
+                      attrs={'scale': 1.0})
+        blk.append_op(type='print', inputs={'X': x}, outputs={},
+                      attrs={'message': 'hi'})
+    opt, stats = passes.optimize_program(main, (out.name,),
+                                         skip={'fuse_elementwise'})
+    assert stats['passes']['dce']['ops_removed'] == 0
+    assert sorted(_op_types(opt)) == ['print', 'scale', 'scale']
+
+
+def test_dce_kill_on_overwrite():
+    """A write fully overwritten before any read is dead (the sharper
+    rule the analysis D005 reporter deliberately does not use)."""
+    from paddle_tpu.core.framework import Operator
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.scale(x, scale=2.0)
+        blk = main.global_block()
+        # dead first write: out is rewritten from x before anyone reads it
+        blk.ops.insert(1, Operator(blk, 'scale', inputs={'X': x},
+                                   outputs={'Out': out},
+                                   attrs={'scale': 9.0}))
+    opt, stats = passes.optimize_program(main, (out.name,),
+                                         skip={'fuse_elementwise'})
+    assert stats['passes']['dce']['ops_removed'] == 1
+
+
+# ----------------------------------------------------------- const fold
+
+def test_const_fold_scale_cast_chain():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        c = fluid.layers.fill_constant([2], 'float32', 3.0)
+        s = fluid.layers.scale(c, scale=2.0, bias=1.0)   # 7.0
+        out = fluid.layers.cast(s, 'int32')              # 7
+    opt, stats = passes.optimize_program(
+        main, (out.name,), skip={'fuse_elementwise'})
+    assert stats['passes']['const_fold']['ops_folded'] == 2
+    # the whole chain is now ONE fill_constant producing the fetch
+    assert _op_types(opt) == ['fill_constant']
+    op = opt.global_block().ops[0]
+    assert op.attrs['value'] == 7 and op.attrs['dtype'] == 'int32'
+    assert op.output_names() == [out.name]
+
+
+def test_const_fold_binary_and_negative():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[2], dtype='float32')
+        a = fluid.layers.fill_constant([2], 'float32', 3.0)
+        b = fluid.layers.fill_constant([2], 'float32', 4.0)
+        const_sum = a + b                      # foldable -> 7.0
+        dyn = x + a                            # NOT foldable (x dynamic)
+        out = dyn + const_sum
+    opt, stats = passes.optimize_program(
+        main, (out.name,), skip={'fuse_elementwise'})
+    assert stats['passes']['const_fold']['ops_folded'] == 1
+    types = _op_types(opt)
+    assert types.count('elementwise_add') == 2  # dyn + out stay
+    folded = [op for op in opt.global_block().ops
+              if op.type == 'fill_constant' and
+              op.attrs.get('value') == 7.0]
+    assert len(folded) == 1
+
+
+# ------------------------------------------------------------------ cse
+
+def test_cse_dedupes_identical_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=2.0)   # duplicate of a
+        c = fluid.layers.scale(x, scale=3.0)   # different attrs: kept
+        out = (a + b) + c
+    opt, stats = passes.optimize_program(
+        main, (out.name,), skip={'fuse_elementwise'})
+    assert stats['passes']['cse']['ops_removed'] == 1
+    assert _op_types(opt).count('scale') == 2
+    # the reader of b's output now reads a's
+    add1 = [op for op in opt.global_block().ops
+            if op.type == 'elementwise_add'][0]
+    assert add1.inputs['X'] == add1.inputs['Y']
+
+
+def test_cse_skips_rng_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        d1 = fluid.layers.dropout(x, dropout_prob=0.5)
+        d2 = fluid.layers.dropout(x, dropout_prob=0.5)
+        out = d1 + d2   # two DIFFERENT draws must stay two draws
+    opt, stats = passes.optimize_program(
+        main, (out.name,), skip={'fuse_elementwise'})
+    assert stats['passes']['cse']['ops_removed'] == 0
+    assert _op_types(opt).count('dropout') == 2
+
+
+# ----------------------------------------------------------------- fuse
+
+def test_fuse_chain_and_execution():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        h = fluid.layers.scale(x, scale=2.0, bias=1.0)
+        h = fluid.layers.relu(h)
+        out = fluid.layers.cast(h, 'float32')
+    opt, stats = passes.optimize_program(main, (out.name,))
+    assert stats['passes']['fuse_elementwise']['chains'] == 1
+    assert stats['passes']['fuse_elementwise']['ops_fused'] == 3
+    assert _op_types(opt) == ['fused_elementwise']
+    fop = opt.global_block().ops[0]
+    assert fop.attrs['out_names'] == [out.name]
+    assert [s['type'] for s in fop.attrs['sub_ops']] == \
+        ['scale', 'relu', 'cast']
+    # source_loc points at the FIRST original op's model line, not here
+    assert fop.source_loc is not None
+    # and it executes: y = relu(2x+1)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    xv = np.array([[-3.0, -0.5, 0.0, 2.0]], 'float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        yv, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+    np.testing.assert_array_equal(yv, np.maximum(2 * xv + 1, 0.0))
+
+
+def test_fuse_escaping_intermediate_stays_fetchable():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        mid = fluid.layers.scale(x, scale=2.0)
+        out = fluid.layers.relu(mid)
+    opt, stats = passes.optimize_program(main, (mid.name, out.name))
+    fop = opt.global_block().ops[0]
+    assert sorted(fop.attrs['out_names']) == sorted([mid.name, out.name])
+    exe, scope = fluid.Executor(), fluid.Scope()
+    xv = np.array([[-1.0, 1.0, -2.0, 2.0]], 'float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        mv, ov = exe.run(main, feed={'x': xv}, fetch_list=[mid, out])
+    np.testing.assert_array_equal(mv, 2 * xv)
+    np.testing.assert_array_equal(ov, np.maximum(2 * xv, 0.0))
+
+
+def test_fuse_parallel_optimizer_run_collapses():
+    """Independent per-param updates are a DAG run, not a linear chain —
+    they still fuse to one op (the transformer's 158 adam ops)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            h = fluid.layers.fc(x, 8, act='relu')
+            loss = fluid.layers.mean(fluid.layers.fc(h, 1))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+    raw_adams = _op_types(main).count('adam')
+    assert raw_adams >= 4
+    opt, stats = passes.optimize_program(main, (loss.name,))
+    assert _op_types(opt).count('adam') == 0
+    assert stats['op_count_opt'] < stats['op_count_raw']
+    fused = [op for op in opt.global_block().ops
+             if op.type == 'fused_elementwise']
+    sub_types = [s['type'] for f in fused for s in f.attrs['sub_ops']]
+    assert sub_types.count('adam') == raw_adams
+
+
+def test_fused_sub_ops_are_jsonable():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.relu(fluid.layers.scale(x, scale=2.0))
+    opt, _ = passes.optimize_program(main, (out.name,))
+    from paddle_tpu import io as fluid_io
+    json.dumps(fluid_io.program_to_desc(opt))  # must not raise
+
+
+# ---------------------------------------------------------------- canon
+
+def test_canon_narrows_int64_attrs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        c = fluid.layers.fill_constant([2], 'int64', 5)
+    opt, stats = passes.optimize_program(main, (c.name,))
+    assert stats['passes']['canon']['attrs_narrowed'] >= 1
+    ops = opt.global_block().ops
+    (op,) = ops
+    attrs = (op.attrs if op.type == 'fill_constant'
+             else op.attrs['sub_ops'][0]['attrs'])
+    assert attrs['dtype'] == 'int32'
+
+
+def test_canon_dedupes_cross_block_initializers():
+    """A loop-body fill_constant identical to a never-rebound root one
+    rewrites to an `assign` of the root var (which traces to nothing) —
+    the constant materializes once per program, not once per body.  The
+    fuse pass is skipped so the initializers stay visible to canon (with
+    fusion on, body constants get swallowed into fused ops instead)."""
+    from paddle_tpu import layers
+    i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+    n = layers.fill_constant(shape=[1], dtype='int64', value=3)
+    k = layers.fill_constant(shape=[1], dtype='float32', value=2.5)
+    total = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+    cond = layers.less_than(i, n)
+    w = layers.While(cond)
+    with w.block():
+        # identical to k's initializer, inside the loop body
+        dup = layers.fill_constant(shape=[1], dtype='float32', value=2.5)
+        layers.assign(total + dup, total)
+        layers.increment(i, 1)
+        layers.less_than(i, n, cond=cond)
+    main = fluid.default_main_program()
+    opt, stats = passes.optimize_program(
+        main, (total.name, k.name), skip={'fuse_elementwise'})
+    assert stats['passes']['canon']['initializers_deduped'] == 1
+    sub_types = [op.type for op in opt.blocks[1].ops]
+    assert 'fill_constant' not in sub_types and 'assign' in sub_types
+    # and the loop still computes 3 * 2.5
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        tv, = exe.run(main, fetch_list=[total])
+    np.testing.assert_allclose(tv, [7.5], rtol=1e-6)
+
+
+# ----------------------------------------------------- pipeline plumbing
+
+def test_pipeline_idempotent():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            h = fluid.layers.fc(x, 8, act='relu')
+            h = fluid.layers.dropout(h, dropout_prob=0.3)
+            loss = fluid.layers.mean(fluid.layers.fc(h, 1))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+    from paddle_tpu import io as fluid_io
+    opt1, _ = passes.optimize_program(main, (loss.name,))
+    opt2, stats2 = passes.optimize_program(opt1, (loss.name,))
+    assert stats2['op_count_raw'] == stats2['op_count_opt']
+    assert json.dumps(fluid_io.program_to_desc(opt1), sort_keys=True,
+                      default=str) == \
+        json.dumps(fluid_io.program_to_desc(opt2), sort_keys=True,
+                   default=str)
+
+
+def test_pt_opt_kill_switch(monkeypatch):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.relu(fluid.layers.scale(x, scale=2.0))
+    monkeypatch.setenv('PT_OPT', '0')
+    prog, stats = passes.maybe_optimize(main, (out.name,))
+    assert prog is main and stats is None
+    assert passes.config_token() == ('off',)
+
+
+def test_pt_opt_skip_selectivity(monkeypatch):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        dead = fluid.layers.scale(x, scale=9.0)  # noqa: F841
+        out = fluid.layers.relu(fluid.layers.scale(x, scale=2.0))
+    monkeypatch.setenv('PT_OPT_SKIP', 'fuse_elementwise')
+    opt, stats = passes.maybe_optimize(main, (out.name,))
+    assert 'fuse_elementwise' not in stats['passes']
+    assert stats['passes']['dce']['ops_removed'] == 1   # dce still ran
+    assert 'fused_elementwise' not in _op_types(opt)
+    assert passes.config_token() == ('on', 'fuse_elementwise')
+
+
+def test_maybe_optimize_memoizes(monkeypatch):
+    monkeypatch.delenv('PT_OPT', raising=False)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.relu(fluid.layers.scale(x, scale=2.0))
+    p1, s1 = passes.maybe_optimize(main, (out.name,))
+    p2, s2 = passes.maybe_optimize(main, (out.name,))
+    assert p1 is p2 and s1 is s2
+    main._bump()
+    p3, _ = passes.maybe_optimize(main, (out.name,))
+    assert p3 is not p1
+
+
+# ------------------------------------------------------- bitwise parity
+
+def _train_model(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, 16, act='relu')
+            h = fluid.layers.dropout(h, dropout_prob=0.4)
+            logits = fluid.layers.fc(h, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _feeds(K, batch=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'x': rng.randn(batch, 8).astype('float32'),
+             'lbl': rng.randint(0, 4, (batch, 1)).astype('int64')}
+            for _ in range(K)]
+
+
+def _train(monkeypatch, pt_opt, runner):
+    monkeypatch.setenv('PT_OPT', pt_opt)
+    main, startup, loss = _train_model()
+    losses, scope = runner(main, startup, loss)
+    state = {n: np.asarray(v) for n, v in scope.vars.items()}
+    return np.asarray(losses), state
+
+
+def _assert_bitwise(monkeypatch, runner):
+    l1, s1 = _train(monkeypatch, '1', runner)
+    l0, s0 = _train(monkeypatch, '0', runner)
+    np.testing.assert_array_equal(l1, l0)
+    assert set(s1) == set(s0)
+    for n in s1:   # params AND Adam moments, bit for bit
+        np.testing.assert_array_equal(s1[n], s0[n], err_msg=n)
+
+
+def test_bitwise_parity_run(monkeypatch):
+    def runner(main, startup, loss):
+        exe, scope = fluid.Executor(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = [np.asarray(exe.run(main, feed=f,
+                                         fetch_list=[loss])[0])
+                      for f in _feeds(4)]
+        return losses, scope
+    _assert_bitwise(monkeypatch, runner)
+
+
+def test_bitwise_parity_run_steps(monkeypatch):
+    def runner(main, startup, loss):
+        exe, scope = fluid.Executor(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            stacked, = exe.run_steps(main, feed_list=_feeds(4),
+                                     fetch_list=[loss])
+        return np.asarray(stacked), scope
+    _assert_bitwise(monkeypatch, runner)
+
+
+def test_bitwise_parity_parallel_executor(monkeypatch):
+    from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+
+    def runner(main, startup, loss):
+        exe, scope = fluid.Executor(), fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                                  scope=scope)
+            losses = [np.asarray(pe.run([loss.name], feed=f)[0])
+                      for f in _feeds(2, batch=8)]
+        return losses, scope
+    _assert_bitwise(monkeypatch, runner)
+
+
+# -------------------------------------------------- saved-model roundtrip
+
+def test_saved_model_roundtrip_of_optimized_program(tmp_path):
+    from paddle_tpu import io as fluid_io
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            h = fluid.layers.fc(x, 8, act='relu')
+            out = fluid.layers.scale(h, scale=0.5, bias=1.0)
+    opt, stats = passes.optimize_program(main, (out.name,))
+    assert 'fused_elementwise' in _op_types(opt)
+
+    xv = np.random.RandomState(0).randn(3, 8).astype('float32')
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want, = exe.run(main, feed={'x': xv}, fetch_list=[out])
+        # save the OPTIMIZED program (fused ops serialize through their
+        # JSON-able sub_ops attrs) and reload it into a fresh program
+        fluid_io.save_inference_model(
+            str(tmp_path), ['x'], [opt.global_block().var(out.name)],
+            exe, main_program=opt)
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feeds2, fetches2 = fluid_io.load_inference_model(
+            str(tmp_path), exe2)
+        got, = exe2.run(prog2, feed={'x': xv}, fetch_list=fetches2)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_program_lint_optimize_flag():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        dead = fluid.layers.scale(x, scale=9.0)  # noqa: F841
+        out = fluid.layers.relu(fluid.layers.scale(x, scale=2.0))
+    raw = main.lint(feed_names=('x',), fetch_list=[out])
+    assert any(d.code == 'D005' for d in raw)       # dead op visible
+    opted = main.lint(feed_names=('x',), fetch_list=[out], optimize=True)
+    assert not any(d.code == 'D005' for d in opted)  # rewriter removed it
+    assert not opted.errors                          # fused program clean
+
+
+def test_retrace_explainer_names_pt_opt_toggle(monkeypatch):
+    import paddle_tpu.observability as obs
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.relu(fluid.layers.scale(x, scale=2.0))
+    exe, scope = fluid.Executor(), fluid.Scope()
+    xv = np.ones((2, 4), 'float32')
+    obs.explainer().reset()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        monkeypatch.setenv('PT_OPT', '1')
+        exe.run(main, feed={'x': xv}, fetch_list=[out])
+        monkeypatch.setenv('PT_OPT', '0')
+        exe.run(main, feed={'x': xv}, fetch_list=[out])
+    rep = obs.explainer().last_report()
+    assert rep['kind'] == 'retrace'
+    assert any('PT_OPT' in d for d in rep['details'])

@@ -17,6 +17,82 @@ if [ "$lint_rc" -ne 0 ]; then
     echo "ci_smoke: pt-lint FAILED (rc=$lint_rc)"
 fi
 
+echo "== ci_smoke: pt-lint over bundled models (post-optimization) =="
+# the PT_OPT rewriter gate, part 1 (docs/passes.md): every zoo program
+# must ALSO lint error-free after the optimizing pipeline rewrote it —
+# a pass emitting broken fused/folded ops shows up here
+timeout -k 10 600 env JAX_PLATFORMS=cpu python tools/pt_lint.py \
+    --all-builtin --optimize --fail-on error
+opt_lint_rc=$?
+if [ "$opt_lint_rc" -ne 0 ]; then
+    echo "ci_smoke: pt-lint --optimize FAILED (rc=$opt_lint_rc)"
+fi
+
+echo "== ci_smoke: opt pipeline op-count + bitwise parity =="
+# the PT_OPT rewriter gate, part 2: the bench transformer program must
+# shrink through the pipeline, and PT_OPT=1 training must be bitwise
+# equal to PT_OPT=0 (losses AND end-of-run param/Adam state)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 python - <<'EOF'
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import passes
+from paddle_tpu.models import transformer as tr
+
+def build(B=2, T=16):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            out = tr.build(src_vocab=256, trg_vocab=256, max_len=T,
+                           n_layer=2, n_head=2, d_model=32, d_inner=64,
+                           dropout=0.1, use_flash=False)
+    return main, startup, out
+
+main, _, out = build()
+opt, stats = passes.optimize_program(main, (out['loss'].name,))
+raw, cut = stats['op_count_raw'], stats['op_count_opt']
+if not cut < raw:
+    sys.exit('ci_smoke: opt pipeline did not shrink the program '
+             '(raw=%d opt=%d)' % (raw, cut))
+print('ci_smoke: opt op-count %d -> %d (-%.0f%%, %d fused, %d removed)'
+      % (raw, cut, 100.0 * (raw - cut) / raw, stats['ops_fused'],
+         stats['ops_removed']))
+
+def train(pt_opt):
+    os.environ['PT_OPT'] = pt_opt
+    main, startup, out = build()
+    main.set_amp(True)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = tr.synthetic_batch(rng, 2, 16, 256)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[out['loss']])[0])
+                  for _ in range(2)]
+    return losses, {n: np.asarray(v) for n, v in scope.vars.items()}
+
+l1, s1 = train('1')
+l0, s0 = train('0')
+for a, b in zip(l1, l0):
+    if not np.array_equal(a, b):
+        sys.exit('ci_smoke: PT_OPT=1 losses diverge from PT_OPT=0: '
+                 '%r vs %r' % (a, b))
+bad = [n for n in s1 if not np.array_equal(s1[n], s0.get(n))]
+if set(s1) != set(s0) or bad:
+    sys.exit('ci_smoke: PT_OPT=1 end-of-run state diverges: %s'
+             % bad[:5])
+print('ci_smoke: PT_OPT=1 bitwise-equal to PT_OPT=0 '
+      '(%d steps, %d state arrays)' % (len(l1), len(s1)))
+EOF
+opt_gate_rc=$?
+if [ "$opt_gate_rc" -ne 0 ]; then
+    echo "ci_smoke: opt pipeline gate FAILED (rc=$opt_gate_rc)"
+fi
+
 echo "== ci_smoke: ruff =="
 # style/bug gate with the committed ruff.toml; the container image may
 # not ship ruff — skip with a notice rather than fail the smoke
@@ -79,6 +155,8 @@ tel = rec['telemetry']
 tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'compiles', 'compile_s', 'compile_s_cold', 'compile_s_warm',
                 'compile_cache_hits', 'compile_cache_misses', 'tail_splits',
+                'trace_s', 'backend_compile_s', 'program_op_count_raw',
+                'program_op_count_opt', 'opt_pass_ms', 'opt_ops_fused',
                 'stall_count', 'prefetch_starvation_s', 'fetch_sync_s']
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
@@ -98,6 +176,10 @@ if tel['tail_splits'] < 1:
     sys.exit('ci_smoke: tail_splits=%r — the ragged-tail superbatch did '
              'not route through the single-step executable'
              % tel['tail_splits'])
+if not tel['program_op_count_opt'] < tel['program_op_count_raw']:
+    sys.exit('ci_smoke: PT_OPT rewriter did not shrink the bench program '
+             '(raw=%r opt=%r)' % (tel['program_op_count_raw'],
+                                  tel['program_op_count_opt']))
 
 # warm-start contract: second fresh process over the same PT_CACHE_DIR
 # serves executables from disk instead of compiling them
@@ -125,4 +207,5 @@ if [ "$t1_rc" -ne 0 ]; then
     echo "ci_smoke: tier-1 tests FAILED (rc=$t1_rc)"
 fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
-    [ "$ruff_rc" -eq 0 ]
+    [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
+    [ "$opt_gate_rc" -eq 0 ]

@@ -106,7 +106,7 @@ def _lint_one(label, build_fn, args):
         bucketer = fluid.FeedBucketer(mask_name='__mask__',
                                       seq_names=args.seq_names or ())
     result = program.lint(feed_names=feeds, fetch_list=fetches,
-                          bucketer=bucketer)
+                          bucketer=bucketer, optimize=args.optimize)
     return label, result, None
 
 
@@ -142,6 +142,12 @@ def main(argv=None):
                          'feed (repeatable; informs the retrace pass)')
     ap.add_argument('--bucketed', action='store_true',
                     help='assume a FeedBucketer pads the batch dim')
+    ap.add_argument('--optimize', action='store_true',
+                    help='run the PT_OPT rewriter pipeline (core/passes, '
+                         'honoring PT_OPT_SKIP) first and lint the '
+                         'OPTIMIZED program — what the executor actually '
+                         'traces under PT_OPT=1; diagnostics still name '
+                         'model source lines (docs/passes.md)')
     args = ap.parse_args(argv)
 
     if args.list_builtin:
