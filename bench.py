@@ -22,7 +22,9 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
+import traceback
 
 import numpy as np
 
@@ -52,22 +54,72 @@ print('PROBE_OK', d[0].platform, '|', d[0].device_kind)
 """
 
 
-def probe_backend():
+def probe_backend(retries=None):
     """Run a trivial device computation in a subprocess with a timeout.
-    Returns (platform, device_kind) or (None, reason)."""
-    try:
-        r = subprocess.run([sys.executable, '-c', _PROBE_CODE],
-                           capture_output=True, text=True,
-                           timeout=PROBE_TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        return None, 'probe timed out after %ds (PJRT init hang)' % \
-            PROBE_TIMEOUT_S
-    for line in r.stdout.splitlines():
-        if line.startswith('PROBE_OK'):
-            _, platform, _, kind = line.split(None, 3)
-            return platform, kind
-    tail = (r.stderr or r.stdout).strip().splitlines()[-8:]
-    return None, 'probe rc=%d: %s' % (r.returncode, ' | '.join(tail))
+    A failed/hung probe is retried once (BENCH_r05 lost a whole round to
+    one transient 300s PJRT init hang).  Returns (platform, device_kind)
+    or (None, reason)."""
+    if retries is None:
+        retries = int(os.environ.get('BENCH_PROBE_RETRIES', '1'))
+    reason = 'probe never ran'
+    for attempt in range(retries + 1):
+        try:
+            r = subprocess.run([sys.executable, '-c', _PROBE_CODE],
+                               capture_output=True, text=True,
+                               timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            reason = 'probe timed out after %ds (PJRT init hang)' % \
+                PROBE_TIMEOUT_S
+        else:
+            for line in r.stdout.splitlines():
+                if line.startswith('PROBE_OK'):
+                    _, platform, _, kind = line.split(None, 3)
+                    return platform, kind
+            tail = (r.stderr or r.stdout).strip().splitlines()[-8:]
+            reason = 'probe rc=%d: %s' % (r.returncode, ' | '.join(tail))
+        if attempt < retries:
+            print('BENCH: backend probe failed (%s) — retrying (%d/%d)'
+                  % (reason, attempt + 1, retries), file=sys.stderr)
+    return None, reason
+
+
+# ---------------------------------------------------------------- watchdog
+# A hung in-process compile/launch used to produce a DEAD round: no JSON,
+# no diagnosis.  The watchdog emits a structured {"error": ...} JSON tail
+# naming the last stage the bench entered, dumps every thread's stack to
+# stderr, and exits hard.  BENCH_WATCHDOG_S=0 disables.
+_STAGE = ['startup']
+
+
+def stage(name):
+    _STAGE[0] = name
+    print('BENCH: stage=%s' % name, file=sys.stderr)
+
+
+def _emit_error(kind, detail):
+    print(json.dumps({'error': kind, 'stage': _STAGE[0],
+                      'detail': str(detail)[:2000]}), flush=True)
+
+
+def install_watchdog():
+    budget = float(os.environ.get('BENCH_WATCHDOG_S', '1800'))
+    if budget <= 0:
+        return None
+
+    def _trip():
+        _emit_error('watchdog expired after %.0fs' % budget,
+                    'bench hung in stage %r' % _STAGE[0])
+        try:
+            import faulthandler
+            faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:
+            pass
+        os._exit(3)
+
+    t = threading.Timer(budget, _trip)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def peak_flops(device_kind):
@@ -162,6 +214,7 @@ def bench_resnet50(on_tpu, device_kind):
 
 
 def main():
+    stage('probe')
     platform, kind_or_reason = probe_backend()
     fallback_reason = None
     if platform is None:
@@ -201,7 +254,7 @@ def main():
     # tiny-shape warmup first: a failure or hang surfaces on a 2s compile,
     # not after the full-size 30s one
     t0 = time.perf_counter()
-    print('BENCH: tiny warmup compile...', file=sys.stderr)
+    stage('tiny_warmup')
     _tiny_warmup(fluid, vocab)
     print('BENCH: tiny warmup ok (%.1fs)' % (time.perf_counter() - t0),
           file=sys.stderr)
@@ -226,6 +279,7 @@ def main():
 
     with fluid.scope_guard(scope):
         t0 = time.perf_counter()
+        stage('startup')
         exe.run(startup)
         print('BENCH: startup ok (%.1fs)' % (time.perf_counter() - t0),
               file=sys.stderr)
@@ -235,11 +289,13 @@ def main():
         import jax
         feed = {k: jax.device_put(v) for k, v in feed.items()}
         t0 = time.perf_counter()
+        stage('train_warmup')
         for _ in range(3):  # compile + warmup
             loss, = exe.run(main_prog, feed=feed, fetch_list=[out['loss']])
         np.asarray(loss)  # block
         print('BENCH: train-step compile+warmup ok (%.1fs)'
               % (time.perf_counter() - t0), file=sys.stderr)
+        stage('measure')
         steps = 30 if on_tpu else 10
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -335,7 +391,17 @@ def main():
         'prefetch_starvation_s': round(
             snap1.get('prefetch.starvation_s') or 0.0, 3),
         'fetch_sync_s': round(snap1.get('executor.fetch_sync_s') or 0.0, 3),
+        # graceful-degradation accounting (ops/_fallback.py): nonzero
+        # means a pallas kernel silently rerouted to its composed/jnp
+        # path — this number is how BENCH_r04's lost gather round becomes
+        # impossible to miss
+        'kernel_fallbacks': int(snap1.get('kernel.fallbacks') or 0),
     }
+    if telemetry['kernel_fallbacks']:
+        print('BENCH: WARNING — %d kernel fallback(s): a pallas kernel '
+              'degraded to its composed path (run PT_STRICT_KERNELS=1 '
+              'to get the raw error)' % telemetry['kernel_fallbacks'],
+              file=sys.stderr)
     if telemetry['retraces']:
         print('BENCH: WARNING — %d retrace(s) DURING the measured fused '
               'loop; the number below is compile-polluted'
@@ -361,6 +427,7 @@ def main():
     except Exception as e:  # noqa: BLE001 - diagnostic-only path
         print('BENCH: allreduce microbench failed: %s' % e, file=sys.stderr)
 
+    stage('resnet50')
     resnet_rec = {}
     try:
         resnet_rec = bench_resnet50(on_tpu, device_kind)
@@ -370,6 +437,7 @@ def main():
         print('BENCH: resnet50 bench failed: %s' % e, file=sys.stderr)
         resnet_rec = {'resnet50_error': str(e)[:200]}
 
+    stage('report')
     rec = {
         'metric': 'transformer_base_tokens_per_sec_per_chip',
         'value': round(tps, 1),
@@ -414,4 +482,18 @@ def _tiny_warmup(fluid, vocab):
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    _wd = install_watchdog()
+    try:
+        rc = main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 - structured JSON death
+        # a crashed bench still leaves a diagnosable artifact: the last
+        # line is {"error": ..., "stage": ...} instead of a bare stack
+        traceback.print_exc()
+        _emit_error(type(e).__name__, e)
+        sys.exit(1)
+    finally:
+        if _wd is not None:
+            _wd.cancel()
+    sys.exit(rc)

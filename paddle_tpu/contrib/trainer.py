@@ -90,14 +90,24 @@ class Trainer(object):
                 fluid_io.load_persistables(self.exe, param_path,
                                            self.train_program)
         self.checkpointer = None
+        self._resume_epoch = 0
+        self._resume_step = -1
         if checkpoint_config:
             self.checkpointer = Checkpointer(checkpoint_config, self.exe,
-                                             self.train_program)
-            with scope_guard(self.scope):
-                meta = self.checkpointer.restore()
-            self._resume_epoch = meta['epoch_id'] if meta else 0
-        else:
-            self._resume_epoch = 0
+                                             self.train_program,
+                                             scope=self.scope)
+            meta = self.checkpointer.restore()
+            if meta:
+                # resume at STEP granularity: the resume epoch replays
+                # only the reader entries after the checkpointed step,
+                # with the restored RNG counters keeping the stream
+                # bitwise-identical to the uninterrupted run
+                self._resume_epoch = meta['epoch_id']
+                self._resume_step = meta.get('step_id', -1)
+            if self.checkpointer.config.handle_signals:
+                # preemption safety: SIGTERM/SIGINT flush one final
+                # checkpoint at the last recorded step before exiting
+                self.checkpointer.install_signal_handlers()
         self.__stop = False
 
     def stop(self):
@@ -113,24 +123,36 @@ class Trainer(object):
         feed_vars = [program.global_block().var(n) for n in feed_order]
         return DataFeeder(feed_vars, program=program)
 
+    def _resume_skip(self, epoch_id):
+        """How many leading reader entries of this epoch a checkpoint
+        already covers (0 beyond the resume epoch)."""
+        if epoch_id == self._resume_epoch and self._resume_step >= 0:
+            return self._resume_step + 1
+        return 0
+
     def train(self, num_epochs, event_handler, reader=None,
-              feed_order=None, steps_per_launch=1):
+              feed_order=None, steps_per_launch=1, recovery=None):
         """steps_per_launch=K fuses K train iterations into ONE device
         launch (Executor.run_steps — a jitted lax.scan), amortizing the
         per-launch dispatch cost.  Step events still fire per iteration
         with that iteration's metrics (sliced from the stacked fetches);
         BeginStepEvent.fetch_metrics is honored at launch granularity
-        (the first step's choice governs its whole launch)."""
+        (the first step's choice governs its whole launch).
+
+        recovery: a train.RecoveryPolicy — a diverged launch (check_nan
+        trip or loss spike) rolls back to the last checkpoint and the
+        offending superbatch is skipped instead of killing the run."""
         if steps_per_launch <= 1:
             return self._train_single(num_epochs, event_handler, reader,
-                                      feed_order)
+                                      feed_order, recovery)
         feeder = self._feeder(feed_order, self.train_program)
         K = int(steps_per_launch)
         with scope_guard(self.scope):
             for epoch_id in range(self._resume_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
+                skip = self._resume_skip(epoch_id)
                 buf = []
-                step_id = 0
+                step_id = skip
                 stopped = False
 
                 def flush(buf, step_id):
@@ -140,10 +162,15 @@ class Trainer(object):
                         event_handler(BeginStepEvent(epoch_id, step_id + i))
                     fetch = [m.name for m in self.metrics] \
                         if begin.fetch_metrics else []
-                    stacked = self.exe.run_steps(self.train_program,
-                                                 feed_list=buf,
-                                                 fetch_list=fetch,
-                                                 steps=len(buf))
+                    launch = lambda: self.exe.run_steps(  # noqa: E731
+                        self.train_program, feed_list=buf,
+                        fetch_list=fetch, steps=len(buf))
+                    stacked = launch() if recovery is None \
+                        else recovery.run(launch)
+                    if stacked is None:
+                        # diverged + rolled back: the superbatch is
+                        # skipped, its step ids stay consumed
+                        return step_id + len(buf)
                     telem = _telemetry_snapshot()
                     for i in range(len(buf)):
                         metrics = [np.asarray(m[i]) for m in stacked]
@@ -154,7 +181,9 @@ class Trainer(object):
                                                    metrics, telemetry=telem))
                     return step_id + len(buf)
 
-                for data in reader():
+                for i, data in enumerate(reader()):
+                    if i < skip:
+                        continue
                     if self.__stop:
                         stopped = True
                         break
@@ -170,12 +199,16 @@ class Trainer(object):
                     return
                 event_handler(EndEpochEvent(epoch_id))
 
-    def _train_single(self, num_epochs, event_handler, reader, feed_order):
+    def _train_single(self, num_epochs, event_handler, reader, feed_order,
+                      recovery=None):
         feeder = self._feeder(feed_order, self.train_program)
         with scope_guard(self.scope):
             for epoch_id in range(self._resume_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
+                skip = self._resume_skip(epoch_id)
                 for step_id, data in enumerate(reader()):
+                    if step_id < skip:
+                        continue
                     if self.__stop:
                         if self.checkpointer:
                             self.checkpointer.save(epoch_id, step_id)
@@ -184,9 +217,13 @@ class Trainer(object):
                     event_handler(begin)
                     fetch = [m.name for m in self.metrics] \
                         if begin.fetch_metrics else []
-                    metrics = self.exe.run(self.train_program,
-                                           feed=feeder.feed(data),
-                                           fetch_list=fetch)
+                    launch = lambda: self.exe.run(  # noqa: E731
+                        self.train_program, feed=feeder.feed(data),
+                        fetch_list=fetch)
+                    metrics = launch() if recovery is None \
+                        else recovery.run(launch)
+                    if metrics is None:
+                        continue   # diverged step rolled back + skipped
                     if self.checkpointer:
                         self.checkpointer.maybe_save(epoch_id, step_id)
                     event_handler(EndStepEvent(

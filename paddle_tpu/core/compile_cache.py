@@ -33,6 +33,8 @@ import threading
 from collections import OrderedDict
 
 from .. import observability as _obs
+from ..testing import faults as _faults
+from .retry import retry_with_backoff
 
 __all__ = ['launch_fingerprint', 'program_fingerprint', 'ExecutableLRU',
            'DiskCache', 'disk_cache', 'cache_dir', 'disk_enabled',
@@ -197,9 +199,20 @@ class DiskCache(object):
         backstop shortcutting the backend compile; ``(None, None)`` is a
         miss."""
         path = self._path(fingerprint)
-        try:
+
+        def _read():
+            _faults.maybe_fail('cache_read')
             with open(path, 'rb') as f:
-                payload = pickle.load(f)
+                return pickle.load(f)
+
+        try:
+            # transient OSErrors (a racing writer's os.replace mid-flight
+            # on a shared PT_CACHE_DIR, NFS hiccups, injected cache_read
+            # faults) retry with backoff; a missing entry is an ordinary
+            # miss and never retries
+            payload = retry_with_backoff(_read, retry_on=(OSError,),
+                                         give_up_on=(FileNotFoundError,),
+                                         name='cache_read')
         except FileNotFoundError:
             return None, None
         except Exception:  # noqa: BLE001 - corruption is a miss
@@ -248,11 +261,19 @@ class DiskCache(object):
         payload['meta'] = dict(meta or {}, env=_environment_blob())
         path = self._path(fingerprint)
         tmp = path + '.tmp.%d' % os.getpid()
-        try:
+
+        def _write():
+            _faults.maybe_fail('cache_write')
             os.makedirs(os.path.dirname(path), exist_ok=True)
             with open(tmp, 'wb') as f:
                 pickle.dump(payload, f)
             os.replace(tmp, path)  # atomic: concurrent readers never see torn
+
+        try:
+            # transient write errors (injected cache_write faults, brief
+            # volume pressure) retry with backoff before giving up
+            retry_with_backoff(_write, retry_on=(OSError,),
+                               name='cache_write')
             _obs.metrics.counter('compile_cache.disk_stores').inc()
             _obs.metrics.counter('compile_cache.bytes_written').inc(
                 os.path.getsize(path))

@@ -19,6 +19,7 @@ from . import passes as _passes
 from .framework import (Variable, default_main_program, TPUPlace,
                         Program)
 from .. import observability as _obs
+from ..testing import faults as _faults
 
 __all__ = ['Executor', 'Scope', 'scope_guard', 'global_scope']
 
@@ -611,6 +612,10 @@ class Executor(object):
         # the seed's dict grew one executable per signature forever
         self._cache = _cc.ExecutableLRU()
         self._run_counter = {}
+        # RNG counters restored from a checkpoint before their base_key
+        # exists (fresh process): consumed on the first run of a matching
+        # (feed names, fetch names) signature — see set_rng_state
+        self._pending_counters = {}
         self._shard_targets = {}
         # largest K ever launched per (program, fetch set): a smaller K
         # against the same program is a ragged tail, and run_steps routes
@@ -624,6 +629,41 @@ class Executor(object):
         self._cache.clear()
         self._shard_targets.clear()
         self._steps_seen.clear()
+
+    # ------------------------------------------------------- rng/run state
+    @staticmethod
+    def _stream_key(feed_names, fetch_names):
+        return '|'.join(sorted(feed_names)) + '=>' + '|'.join(fetch_names)
+
+    def rng_state(self):
+        """JSON-able RNG/run-counter state, keyed program-agnostically by
+        (feed names, fetch names) — id(program) and scope serials don't
+        survive a process restart, the launch *signature* does.  The
+        checkpointer saves this so a resumed run derives the exact
+        per-step RNG keys (dropout masks included) the uninterrupted run
+        would have: the counter fold-in makes the stream a pure function
+        of (program seed, counter)."""
+        out = {}
+        for (pid, ver, feeds, fetch, sserial), v in \
+                self._run_counter.items():
+            k = self._stream_key(feeds, fetch)
+            out[k] = max(int(v), out.get(k, 0))
+        # carry still-unconsumed restored counters through re-checkpoints
+        for k, v in self._pending_counters.items():
+            out.setdefault(k, int(v))
+        return out
+
+    def set_rng_state(self, state):
+        """Restore counters captured by `rng_state`.  Live base_keys with
+        a matching signature are overwritten in place (in-process
+        rollback); unseen signatures are parked and consumed on their
+        first run (fresh-process resume)."""
+        state = {k: int(v) for k, v in (state or {}).items()}
+        for key in list(self._run_counter):
+            k = self._stream_key(key[2], key[3])
+            if k in state:
+                self._run_counter[key] = state.pop(k)
+        self._pending_counters.update(state)
 
     def _resolve_fetch(self, fetch_list):
         names = []
@@ -787,13 +827,27 @@ class Executor(object):
 
     def _gather_params(self, program, params_in, scope, base_key):
         import jax
+        import jax.numpy as jnp
         params = {}
         for n in params_in:
             if n not in scope:
                 raise RuntimeError(
                     'persistable var "%s" not initialized in scope — run the '
                     'startup program first (exe.run(startup_program))' % n)
-            params[n] = scope.vars[n]
+            v = scope.vars[n]
+            if not hasattr(v, 'devices'):
+                # host (numpy) array in scope — a checkpoint restore,
+                # load_persistables, or manual scope.set.  It must be
+                # uploaded into an XLA-OWNED buffer before it meets a
+                # donating executable: on the CPU backend device_put can
+                # zero-copy ALIAS the numpy memory, and donating that
+                # buffer frees memory numpy still owns — observed as
+                # glibc heap corruption in resume-after-restore training.
+                # jnp.array forces the copy; written back so the upload
+                # happens once per restore, not once per launch.
+                v = jnp.array(np.asarray(v))
+                scope.vars[n] = v
+            params[n] = v
         if self.mesh is not None:
             # arrays in scope may carry a different (e.g. replicated)
             # committed sharding from the startup run; reshard to the
@@ -993,8 +1047,26 @@ class Executor(object):
         # runs would — mixed run/run_steps usage shares one stream
         base_key = (id(program), program._version, feed_names, fetch_names,
                     scope._serial)
-        counter = self._run_counter.get(base_key, 0)
+        counter = self._run_counter.get(base_key)
+        if counter is None:
+            # first launch of this signature: a checkpoint-restored
+            # counter (set_rng_state) resumes the stream mid-sequence
+            counter = int(self._pending_counters.pop(
+                self._stream_key(feed_names, fetch_names), 0)) \
+                if self._pending_counters else 0
+        if _faults.any_active():
+            # preemption rehearsal: SIGTERM delivered as step `at` is
+            # ABOUT TO launch — before the counter bump and writeback, so
+            # the signal handler's flushed checkpoint sees scope, RNG
+            # counters, and caller-recorded progress all consistent at
+            # "step at-1 complete"
+            _faults.maybe_kill('sigterm', step=counter, count=steps or 1)
         self._run_counter[base_key] = counter + (steps or 1)
+
+        if _faults.any_active():
+            # nan_step fault site: poison this launch's float feeds so
+            # the fused check_nan verdict trips like a real divergence
+            feed_vals = _faults.poison_nan(feed_vals, counter, steps or 1)
 
         entry, params = self._resolve_entry(
             program, feed_vals, feed_names, fetch_names, scope, steps,
@@ -1047,8 +1119,16 @@ class Executor(object):
             # the culprits (slow, but only runs on actual failure).  For a
             # K-step launch the fetches are stacked [K, ...] and the
             # updates are end-of-scan state — both still name the vars.
-            self._assert_finite(itertools.chain(
-                zip(fetch_names, fetches), updates.items()))
+            # The launch window must CLOSE before the raise: otherwise the
+            # next launch (after a divergence rollback) measures its gap
+            # from the launch before this one and reads the whole failed
+            # step + recovery as a phantom pipeline stall.
+            try:
+                self._assert_finite(itertools.chain(
+                    zip(fetch_names, fetches), updates.items()))
+            finally:
+                if obs_on:
+                    _obs.on_launch_end(self, time.perf_counter())
         if return_numpy:
             # the host-sync point of the launch: converting fetches blocks
             # on the device — its duration is how long the async pipeline

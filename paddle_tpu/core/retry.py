@@ -1,0 +1,54 @@
+"""Shared transient-failure retry: bounded exponential backoff.
+
+Disk I/O on shared infrastructure fails transiently — two processes
+racing on one ``PT_CACHE_DIR``, NFS hiccups, a checkpoint volume briefly
+remounting.  Treating every such error as fatal turned BENCH-grade soaks
+into dead rounds; swallowing them silently hides real corruption.  This
+module gives every disk-touching subsystem (core/compile_cache.py, io.py,
+train/checkpoint.py) one policy: retry with deterministic exponential
+backoff, count every attempt in observability, and re-raise the last
+error once the budget is spent.
+"""
+import os
+import time
+
+from .. import observability as _obs
+
+__all__ = ['retry_with_backoff']
+
+
+def retry_with_backoff(fn, attempts=None, base_delay=0.02, max_delay=0.5,
+                       retry_on=(OSError,), give_up_on=(), name=None,
+                       sleep=time.sleep):
+    """Call ``fn()`` up to ``attempts`` times (default ``PT_RETRIES``+1,
+    env default 2 retries).
+
+    ``retry_on`` exceptions are retried after ``base_delay * 2**i``
+    seconds (capped at ``max_delay``, deterministic — no jitter, so
+    failure-path tests replay exactly); ``give_up_on`` exceptions
+    propagate immediately even when they subclass a retryable type
+    (``FileNotFoundError`` under ``OSError`` is the canonical case: a
+    missing cache entry is a miss, not a transient fault).  Each retry
+    counts into ``retry.attempts`` (and ``retry.attempts.<name>``); an
+    exhausted budget counts ``retry.giveups`` and re-raises."""
+    if attempts is None:
+        attempts = 1 + max(0, int(os.environ.get('PT_RETRIES', '2')))
+    attempts = max(1, int(attempts))
+    for i in range(attempts):
+        try:
+            return fn()
+        except give_up_on:
+            raise
+        except retry_on as e:
+            if i + 1 >= attempts:
+                _obs.metrics.counter('retry.giveups').inc()
+                if name:
+                    _obs.metrics.counter('retry.giveups.%s' % name).inc()
+                raise
+            _obs.metrics.counter('retry.attempts').inc()
+            if name:
+                _obs.metrics.counter('retry.attempts.%s' % name).inc()
+            _obs.tracing.instant('retry.backoff', cat='fault',
+                                 args={'name': name or '?', 'attempt': i + 1,
+                                       'error': repr(e)[:200]})
+            sleep(min(max_delay, base_delay * (2 ** i)))

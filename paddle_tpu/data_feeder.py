@@ -27,6 +27,7 @@ from .core.framework import Variable, default_main_program
 from .core.lod import create_lod_tensor
 from .core.dtypes import convert_dtype
 from . import observability as _obs
+from .testing import faults as _faults
 
 __all__ = ['DataFeeder', 'FeedPrefetcher', 'FeedBucketer']
 
@@ -224,16 +225,24 @@ class FeedPrefetcher(object):
     """
 
     def __init__(self, feeds, steps=1, capacity=2, to_device=True,
-                 bucketer=None):
+                 bucketer=None, skip_steps=0):
         if steps < 1:
             raise ValueError('steps must be >= 1, got %r' % (steps,))
         if capacity < 1:
             raise ValueError('capacity must be >= 1, got %r' % (capacity,))
+        if skip_steps < 0:
+            raise ValueError('skip_steps must be >= 0, got %r'
+                             % (skip_steps,))
         # bucketing happens on the worker thread, before stacking: padded
         # per-step feeds share one shape, so a ragged epoch tail batch no
         # longer breaks np.stack — nor costs a fresh compile signature
         self._src = iter(bucketer.wrap(feeds) if bucketer is not None
                          else feeds)
+        # checkpoint resume: fast-forward past the steps a previous run
+        # already consumed (the cursor() of the checkpointed prefetcher)
+        self._skip = int(skip_steps)
+        self._steps_out = 0
+        self._superbatches_out = 0
         self._steps = int(steps)
         self._to_device = to_device
         self._q = queue.Queue(maxsize=int(capacity))
@@ -292,12 +301,26 @@ class FeedPrefetcher(object):
 
     def _worker(self):
         try:
+            skipped = 0
+            while skipped < self._skip:
+                if self._stop.is_set():
+                    return
+                try:
+                    next(self._src)
+                except StopIteration:
+                    self._put(('done', None))
+                    return
+                skipped += 1
+            if skipped and _obs.enabled():
+                _obs.metrics.counter('prefetch.skipped_steps').inc(skipped)
             buf = []
             for f in self._src:
                 if self._stop.is_set():
                     return
                 buf.append(f)
                 if len(buf) == self._steps:
+                    if _faults.any_active():
+                        _faults.maybe_sleep('prefetch_stall')
                     if not self._put(('batch', self._pack(buf))):
                         return
                     buf = []
@@ -339,7 +362,17 @@ class FeedPrefetcher(object):
             if kind == 'error':
                 self._terminal = ('error', payload)
                 raise payload
+            self._superbatches_out += 1
+            self._steps_out += payload[1]
             yield payload
+
+    def cursor(self):
+        """Absolute position in the feed stream — save it in checkpoint
+        ``extra_meta`` and pass ``skip_steps=cursor()['steps']`` to the
+        resumed prefetcher to fast-forward past consumed batches."""
+        return {'steps': self._skip + self._steps_out,
+                'superbatches': self._superbatches_out,
+                'skipped': self._skip}
 
     def close(self):
         """Stop the worker and release the queue (safe to call twice)."""

@@ -11,6 +11,8 @@ import numpy as np
 from .core.framework import (Program, Variable, Parameter,
                              default_main_program)
 from .core.executor import global_scope
+from .core.retry import retry_with_backoff
+from .testing import faults as _faults
 
 __all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
            'load_params', 'load_persistables', 'save_inference_model',
@@ -48,7 +50,15 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         name = v.name if isinstance(v, Variable) else v
         if name in scope:
             arrays[name] = np.asarray(scope.get(name))
-    np.savez(_store_path(dirname, filename), **arrays)
+    path = _store_path(dirname, filename)
+
+    def _write():
+        _faults.maybe_fail('io_write')
+        np.savez(path, **arrays)
+
+    # transient disk errors retry with backoff; a persistent failure
+    # propagates — a save the caller asked for must not vanish silently
+    retry_with_backoff(_write, retry_on=(OSError,), name='io_write')
 
 
 def _is_param(v):
@@ -74,7 +84,17 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
-    data = np.load(_store_path(dirname, filename), allow_pickle=False)
+    path = _store_path(dirname, filename)
+
+    def _read():
+        _faults.maybe_fail('io_read')
+        return np.load(path, allow_pickle=False)
+
+    # a missing file propagates immediately (caller's contract unchanged);
+    # transient read errors retry with backoff
+    data = retry_with_backoff(_read, retry_on=(OSError,),
+                              give_up_on=(FileNotFoundError,),
+                              name='io_read')
     scope = global_scope()
     names = {v.name if isinstance(v, Variable) else v for v in vars}
     for name in data.files:

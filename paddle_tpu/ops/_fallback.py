@@ -1,0 +1,44 @@
+"""Loud graceful degradation for optional pallas kernels.
+
+Every kernel that can reroute to a composed/jnp implementation at trace
+time funnels the decision through `kernel_fallback`, so degradation is
+never silent again (BENCH_r04 ran a whole TPU round on the jnp.take
+gather without anyone noticing):
+
+  * counts ``kernel.fallbacks`` and ``kernel.fallbacks.<kernel>`` in the
+    observability registry — bench.py surfaces the total in its
+    telemetry JSON block;
+  * warns once per kernel with the underlying error;
+  * under ``PT_STRICT_KERNELS=1`` RAISES instead of falling back — CI
+    and kernel-development runs fail fast on the exact backend error.
+"""
+import os
+import warnings
+
+from .. import observability as _obs
+
+__all__ = ['kernel_fallback', 'strict_kernels']
+
+_warned = set()
+
+
+def strict_kernels():
+    return os.environ.get('PT_STRICT_KERNELS', '0') in ('1', 'true', 'True')
+
+
+def kernel_fallback(kernel, exc, detail=''):
+    """Record that `kernel` failed with `exc` and is about to degrade.
+    Raises under PT_STRICT_KERNELS=1; otherwise counts + warns once and
+    returns so the caller can take its fallback path."""
+    _obs.metrics.counter('kernel.fallbacks').inc()
+    _obs.metrics.counter('kernel.fallbacks.%s' % kernel).inc()
+    _obs.tracing.instant('kernel.fallback', cat='kernel',
+                         args={'kernel': kernel, 'error': repr(exc)[:200]})
+    if strict_kernels():
+        raise RuntimeError(
+            'PT_STRICT_KERNELS=1: %s kernel failed (%r)%s'
+            % (kernel, exc, detail and ' — ' + detail)) from exc
+    if kernel not in _warned:
+        _warned.add(kernel)
+        warnings.warn('%s kernel failed (%r); falling back%s'
+                      % (kernel, exc, detail and ' — ' + detail))

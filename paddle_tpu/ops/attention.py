@@ -325,14 +325,10 @@ def flash_attention(q, k, v, causal=False, scale=None, k_len=None,
                 return _flash_backward(q, k, v, kl, out, lse, g, causal,
                                        scale, bq, bk, interpret) + (None,)
             except Exception as e:  # pragma: no cover - backend-specific
-                global _warned_fallback
-                if not _warned_fallback:
-                    import warnings
-                    warnings.warn(
-                        'flash_attention pallas BACKWARD kernels failed '
-                        '(%r); falling back to the composed gradient '
-                        '(materializes the T^2 scores)' % (e,))
-                    _warned_fallback = True
+                from ._fallback import kernel_fallback
+                kernel_fallback(
+                    'flash_attention_bwd', e,
+                    detail='composed gradient materializes the T^2 scores')
         _, pullback = jax.vjp(
             lambda q, k, v: _ref_attention(q, k, v, causal, scale, kl),
             q, k, v)
@@ -343,13 +339,9 @@ def flash_attention(q, k, v, causal=False, scale=None, k_len=None,
     try:
         return _attn(q, k, v, k_len)
     except Exception as e:  # pragma: no cover - depends on backend
-        global _warned_fallback
-        if not _warned_fallback:
-            import warnings
-            warnings.warn('flash_attention pallas kernels failed (%r); '
-                          'falling back to the composed implementation '
-                          '(unfused, O(T^2) memory)' % (e,))
-            _warned_fallback = True
+        from ._fallback import kernel_fallback
+        kernel_fallback('flash_attention', e,
+                        detail='composed implementation, O(T^2) memory')
         return _ref_attention(q, k, v, causal, scale, k_len)
 
 
@@ -520,7 +512,6 @@ def _flash_backward(q, k, v, k_len, out, lse, g_out, causal, scale,
             dv.reshape(B, Hkv, Tk, D).astype(v.dtype))
 
 
-_warned_fallback = False
 
 
 @register('flash_attention')

@@ -51,6 +51,18 @@ def _gather_kernel(ids_ref, tbl_ref, out_ref, sem, *, block):
     jax.lax.fori_loop(0, block, wait, 0)
 
 
+def _any_memory_space(pltpu):
+    """The HBM/'leave it where it is' memory space moved between jax
+    releases: ``pltpu.ANY`` (<=0.4.x, where MemorySpace doesn't exist)
+    vs ``pltpu.MemorySpace.ANY`` (newer).  BENCH_r04 lost the kernel to
+    exactly this kind of API drift surfacing as a runtime TypeError and
+    silently rerouting to jnp.take — resolve it explicitly."""
+    any_space = getattr(pltpu, 'ANY', None)
+    if any_space is not None:
+        return any_space
+    return pltpu.MemorySpace.ANY
+
+
 def _pallas_gather(tbl, ids, interpret):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -59,7 +71,7 @@ def _pallas_gather(tbl, ids, interpret):
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(N // _BLOCK,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)],
+        in_specs=[pl.BlockSpec(memory_space=_any_memory_space(pltpu))],
         out_specs=pl.BlockSpec((_BLOCK, 1, D), lambda i, ids: (i, 0, 0)),
         scratch_shapes=[pltpu.SemaphoreType.DMA],
     )
@@ -115,13 +127,13 @@ def _kernel_gather(w, idx_flat):
     V, D = w.shape
     return _make_kernel_gather(V, D, jnp.dtype(w.dtype).name)(w, idx_flat)
 
-_warned = False
-
 
 def embedding_gather(w, idx):
     """rows of `w` at `idx` (any idx shape), via the DMA kernel when the
     shapes qualify; falls back to jnp.take otherwise (trace-time
-    failures only — see _eligible for the compile-time kill-switch)."""
+    failures only — see _eligible for the compile-time kill-switch).
+    Fallbacks are LOUD: counted as kernel.fallbacks, warned once, and
+    fatal under PT_STRICT_KERNELS=1 (ops/_fallback.py)."""
     idx_flat = idx.reshape(-1).astype(jnp.int32)
     if _eligible(w, idx_flat):
         # match jnp.take's semantics exactly: negative ids wrap (numpy
@@ -137,10 +149,6 @@ def embedding_gather(w, idx):
             out = jnp.where(oob[:, None], jnp.nan, out)
             return out.reshape(tuple(idx.shape) + (w.shape[1],))
         except Exception as e:  # pragma: no cover - backend-specific
-            global _warned
-            if not _warned:
-                import warnings
-                warnings.warn('pallas embedding gather failed (%r); '
-                              'falling back to jnp.take' % (e,))
-                _warned = True
+            from ._fallback import kernel_fallback
+            kernel_fallback('embedding_gather', e, detail='using jnp.take')
     return jnp.take(w, idx, axis=0)

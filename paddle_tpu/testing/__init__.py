@@ -1,0 +1,11 @@
+"""paddle_tpu.testing — fault injection and resilience test utilities.
+
+The training runtime's failure paths (torn checkpoint writes, transient
+cache I/O errors, NaN bursts, preemption signals, prefetcher stalls) are
+impossible to exercise reliably without a way to *cause* them on demand.
+`faults` provides deterministic, named injection sites driven by the
+``PT_FAULT`` environment variable or the `configure()` API.
+"""
+from . import faults  # noqa: F401
+
+__all__ = ['faults']

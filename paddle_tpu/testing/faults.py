@@ -1,0 +1,237 @@
+"""Deterministic fault injection for the training runtime.
+
+A *fault site* is a named point in the runtime that asks this module
+"should I fail right now?".  Sites are armed via the ``PT_FAULT``
+environment variable (or `configure()`), one comma-separated entry per
+site::
+
+    PT_FAULT="ckpt_write:at=2,nan_step:at=5,prefetch_stall:at=1:s=0.2"
+
+Each entry is ``site[:key=value]*`` with fields
+
+  * ``at=N``     fire on the N-th invocation of the site (1-based), or —
+                 for step-indexed sites like ``nan_step``/``sigterm`` —
+                 at global step counter N (0-based, matching the
+                 executor's RNG/run counter).
+  * ``times=K``  keep firing for K consecutive invocations/steps
+                 (default 1).
+  * ``s=SEC``    sleep duration for stall-type sites (default 0.05).
+
+Injection is deterministic: no randomness, no wall-clock dependence —
+the same program with the same ``PT_FAULT`` fails the same way every
+run, so failure-path tests are exactly as reproducible as happy-path
+ones.  Every fired fault counts into the observability registry as
+``faults.injected`` and ``faults.injected.<site>``.
+
+Instrumented sites (kept in sync with docs/robustness.md):
+
+  ===============  ====================================================
+  ``ckpt_write``   checkpoint writer fails after the tensor file is on
+                   disk but BEFORE the ``_SUCCESS`` marker — a torn
+                   checkpoint (train/checkpoint.py)
+  ``cache_read``   compile-cache disk read raises OSError
+                   (core/compile_cache.py)
+  ``cache_write``  compile-cache disk write raises OSError
+  ``io_read``      io.load_vars tensor read raises OSError (io.py)
+  ``io_write``     io.save_vars tensor write raises OSError
+  ``nan_step``     one training step's float feeds are overwritten with
+                   NaN — loss and gradients blow up and the executor's
+                   fused check_nan verdict trips (core/executor.py)
+  ``prefetch_stall``  the FeedPrefetcher worker sleeps ``s`` seconds
+                   before packing a superbatch (data_feeder.py)
+  ``sigterm``      the process sends itself SIGTERM after step N
+                   completes (core/executor.py) — preemption rehearsal
+  ===============  ====================================================
+"""
+import os
+import signal
+import threading
+import time
+
+from .. import observability as _obs
+
+__all__ = ['configure', 'reset', 'any_active', 'active', 'fire', 'fire_in',
+           'maybe_fail', 'maybe_sleep', 'maybe_kill', 'poison_nan',
+           'InjectedFault', 'SITES']
+
+SITES = ('ckpt_write', 'cache_read', 'cache_write', 'io_read', 'io_write',
+         'nan_step', 'prefetch_stall', 'sigterm')
+
+
+class InjectedFault(OSError):
+    """The exception maybe_fail raises — an OSError subclass so every
+    transient-I/O handler (and retry_with_backoff) treats it exactly
+    like a real disk failure."""
+
+
+class _Fault(object):
+    __slots__ = ('site', 'at', 'times', 'sleep_s', 'hits', 'fired')
+
+    def __init__(self, site, at=1, times=1, s=0.05):
+        self.site = site
+        self.at = int(at)
+        self.times = max(1, int(times))
+        self.sleep_s = float(s)
+        self.hits = 0       # invocation counter for hit-indexed sites
+        self.fired = 0
+
+
+_ACTIVE = {}
+_CONFIGURED = [False]
+_LOCK = threading.Lock()
+
+
+def configure(text=None):
+    """Arm fault sites from a PT_FAULT-style spec string (None re-reads
+    the environment).  Replaces any previous configuration."""
+    with _LOCK:
+        _ACTIVE.clear()
+        if text is None:
+            text = os.environ.get('PT_FAULT', '')
+        for part in (p.strip() for p in text.split(',')):
+            if not part:
+                continue
+            fields = part.split(':')
+            site = fields[0].strip()
+            kw = {}
+            for f in fields[1:]:
+                k, _, v = f.partition('=')
+                k = k.strip()
+                if k not in ('at', 'times', 's'):
+                    raise ValueError(
+                        'PT_FAULT field %r for site %r not understood '
+                        '(known: at=N, times=K, s=SEC)' % (k, site))
+                kw[k] = float(v) if k == 's' else int(v)
+            _ACTIVE[site] = _Fault(site, **kw)
+        _CONFIGURED[0] = True
+    return dict(_ACTIVE)
+
+
+def reset():
+    """Disarm everything and forget the cached env parse (the next site
+    query re-reads PT_FAULT)."""
+    with _LOCK:
+        _ACTIVE.clear()
+        _CONFIGURED[0] = False
+
+
+def _ensure():
+    if not _CONFIGURED[0]:
+        configure()
+
+
+def any_active():
+    """One cheap check for hot paths: is ANY site armed?"""
+    _ensure()
+    return bool(_ACTIVE)
+
+
+def active(site):
+    _ensure()
+    return site in _ACTIVE
+
+
+def _count(site):
+    _obs.metrics.counter('faults.injected').inc()
+    _obs.metrics.counter('faults.injected.%s' % site).inc()
+    _obs.tracing.instant('fault.injected', cat='fault', args={'site': site})
+
+
+def fire(site, step=None):
+    """Deterministic fire decision.  ``step=None`` counts invocations of
+    the site (1-based, fires on hits in [at, at+times)); an explicit
+    ``step`` compares the caller's own index (e.g. the executor's run
+    counter) against the armed window instead."""
+    _ensure()
+    spec = _ACTIVE.get(site)
+    if spec is None:
+        return False
+    with _LOCK:
+        if spec.fired >= spec.times:
+            # budget spent: a rollback that rewinds the caller's step
+            # counter must not re-fire the same fault forever
+            return False
+        if step is None:
+            spec.hits += 1
+            idx = spec.hits
+        else:
+            idx = int(step)
+        if spec.at <= idx < spec.at + spec.times:
+            spec.fired += 1
+            _count(site)
+            return True
+    return False
+
+
+def fire_in(site, start, count):
+    """Step-window variant for fused launches: fires when ANY step in
+    [start, start+count) falls inside the armed window."""
+    _ensure()
+    spec = _ACTIVE.get(site)
+    if spec is None:
+        return False
+    with _LOCK:
+        if spec.fired >= spec.times:
+            return False
+        lo, hi = spec.at, spec.at + spec.times
+        if int(start) < hi and int(start) + int(count) > lo:
+            spec.fired += 1
+            _count(site)
+            return True
+    return False
+
+
+def maybe_fail(site, step=None, exc=None):
+    """Raise at an armed site (InjectedFault — an OSError — by default)."""
+    if fire(site, step):
+        raise (exc or InjectedFault)(
+            'PT_FAULT: injected fault at site %r' % site)
+
+
+def maybe_sleep(site):
+    """Stall-type sites: sleep the armed duration instead of raising."""
+    _ensure()
+    spec = _ACTIVE.get(site)
+    if spec is not None and fire(site):
+        time.sleep(spec.sleep_s)
+
+
+def maybe_kill(site='sigterm', step=None, count=1, sig=signal.SIGTERM):
+    """Preemption rehearsal: deliver a signal to this process when the
+    step window [step, step+count) overlaps the armed window.  Sleeps
+    briefly after the kill so CPython delivers the (asynchronous) Python
+    signal handler HERE — at the instrumented site — instead of a few
+    bytecodes later, keeping the test deterministic."""
+    if not active(site):
+        return
+    hit = (fire_in(site, step, count) if step is not None else fire(site))
+    if hit:
+        os.kill(os.getpid(), sig)
+        for _ in range(100):   # a terminating handler exits long before
+            time.sleep(0.01)
+
+
+def poison_nan(feed_vals, step, count=1):
+    """``nan_step`` site: when the launch's step window [step, step+count)
+    covers the armed step, every float feed array is replaced with NaN —
+    the loss and every gradient blow up, and the executor's fused
+    check_nan verdict trips exactly as it would for a real numeric
+    divergence.  Shapes/dtypes are preserved so the poisoned launch
+    reuses the same executable (no retrace)."""
+    if not active('nan_step') or not fire_in('nan_step', step, count):
+        return feed_vals
+    import numpy as np
+    out = {}
+    for k, v in feed_vals.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            out[k] = np.full(a.shape, np.nan, a.dtype)
+        else:
+            out[k] = v
+    return out
+
+
+def stats():
+    """{site: (hits, fired)} snapshot for tests/diagnostics."""
+    _ensure()
+    return {s: (f.hits, f.fired) for s, f in _ACTIVE.items()}

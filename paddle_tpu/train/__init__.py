@@ -1,4 +1,7 @@
 from . import checkpoint
+from . import recovery
 from .checkpoint import CheckpointConfig, Checkpointer  # noqa: F401
+from .recovery import RecoveryPolicy, DivergenceError  # noqa: F401
 
-__all__ = ['checkpoint', 'CheckpointConfig', 'Checkpointer']
+__all__ = ['checkpoint', 'recovery', 'CheckpointConfig', 'Checkpointer',
+           'RecoveryPolicy', 'DivergenceError']

@@ -1,71 +1,218 @@
-"""Training checkpoint / resume with failure recovery.
+"""Fault-tolerant training checkpoints: async preemption-safe writer,
+newest-valid restorer, full bitwise-resume state.
 
 Parity: reference python/paddle/fluid/trainer.py CheckpointConfig +
 _save_checkpoint/_load_checkpoint (epoch/step metadata, rotation) and the
-contrib fault-tolerance hooks.  TPU-native: persistables are device arrays in
-the Scope; serialization goes through io.save_persistables (numpy .npz under
-the hood), and an atomic SUCCESS marker guards against torn checkpoints from
-mid-write failures.
+contrib fault-tolerance hooks — grown into a resilience primitive:
+
+  * **Async writes.**  ``save()`` snapshots device state to host numpy
+    (the only synchronous part — a device→host copy) and hands the write
+    to a single background thread, so checkpointing never stalls the
+    step loop on disk I/O.  ``wait()`` drains pending writes; a write
+    failure is counted (``ckpt.write_failures``) and warned, not fatal —
+    a lost checkpoint is survivable, a dead soak is not
+    (``CheckpointConfig(strict_writes=True)`` restores raise-on-failure).
+  * **Atomic + torn-proof.**  Each checkpoint is written into a temp dir
+    and renamed into place with a ``_SUCCESS`` marker written last; a
+    crash mid-write can never leave a half-checkpoint that ``restore()``
+    would pick up.  ``restore()`` additionally DELETES torn directories
+    (no marker) and stale temp dirs left by killed writers.
+  * **Full resume state.**  META carries epoch/step, the executor's
+    RNG/run-counter state (`Executor.rng_state`), caller ``extra_meta``
+    (e.g. a FeedPrefetcher cursor), and a wall-clock stamp.  Restoring
+    puts every persistable (params + optimizer accumulators + LR
+    counters) back in the scope AND re-arms the run counters, so a
+    resumed run continues **bitwise-identically** to an uninterrupted
+    one — dropout masks and all (the counter fold-in RNG derivation
+    makes the stream a pure function of saved state).
+  * **Preemption flush.**  ``install_signal_handlers()`` arms SIGTERM/
+    SIGINT to flush one final blocking checkpoint at the last recorded
+    progress before the previous handler (or default death) runs.
+
+Rotation keeps the newest ``max_num_checkpoints`` *valid* dirs.  The
+``ckpt_write`` fault site (testing/faults.py) tears a write between the
+tensor file and the marker, which is how the torn-scan path stays tested.
 """
 import json
 import os
+import queue
 import shutil
+import signal as _signal
 import tempfile
+import threading
+import time
+import warnings
 
-from .. import io as fluid_io
+import numpy as np
+
+from .. import observability as _obs
+from ..testing import faults as _faults
 
 __all__ = ['CheckpointConfig', 'Checkpointer']
 
 _SUCCESS = '_SUCCESS'
 _META = 'META'
+_ARRAYS = '__params__.npz'   # same file the io.save_persistables path used
 
 
 class CheckpointConfig(object):
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10, async_write=True,
+                 strict_writes=False, handle_signals=True):
         self.checkpoint_dir = checkpoint_dir or 'checkpoint'
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
+        self.async_write = bool(async_write)
+        self.strict_writes = bool(strict_writes)
+        # honored by owners that manage a training loop (contrib.Trainer):
+        # arm the SIGTERM/SIGINT final-flush handlers on construction
+        self.handle_signals = bool(handle_signals)
 
 
 class Checkpointer(object):
-    """Periodic checkpoint writer + newest-valid-checkpoint restorer."""
+    """Periodic async checkpoint writer + newest-valid-checkpoint restorer."""
 
-    def __init__(self, config, executor, main_program=None):
+    def __init__(self, config, executor, main_program=None, scope=None):
         if isinstance(config, str):
             config = CheckpointConfig(config)
         self.config = config
         self.executor = executor
         self.main_program = main_program
+        self.scope = scope
         self._serial = -1
+        self._q = queue.Queue()
+        self._pending = 0
+        self._cond = threading.Condition()
+        self._thread = None
+        self._write_error = None
+        self._warned_write = False
+        self._last_progress = None   # (epoch_id, step_id, extra_meta)
+        self._prev_handlers = {}
 
     # --------------------------------------------------------------- save
     def _dir_of(self, serial):
         return os.path.join(self.config.checkpoint_dir,
                             'checkpoint_%d' % serial)
 
+    def _scope(self):
+        if self.scope is not None:
+            return self.scope
+        from ..core.executor import global_scope
+        return global_scope()
+
+    def note_progress(self, epoch_id, step_id, extra_meta=None):
+        """Record where training is WITHOUT saving — the signal-flush
+        handler checkpoints this position when a preemption lands between
+        interval saves."""
+        self._last_progress = (int(epoch_id), int(step_id), extra_meta)
+
     def maybe_save(self, epoch_id, step_id, extra_meta=None):
         """Save if the step/epoch intervals say so; returns the checkpoint
-        dir or None."""
+        dir or None.  Always records progress for the signal flush."""
+        self.note_progress(epoch_id, step_id, extra_meta)
         if step_id % self.config.step_interval != 0 or \
                 epoch_id % self.config.epoch_interval != 0:
             return None
         return self.save(epoch_id, step_id, extra_meta)
 
-    def save(self, epoch_id, step_id, extra_meta=None):
+    def _snapshot(self):
+        """Device → host copy of every persistable in scope.  The copy
+        must be REAL (``np.array(copy=True)``), not ``np.asarray``: on
+        the CPU backend a jax array exposes a ZERO-COPY numpy view of
+        the XLA buffer, and the very next step DONATES that buffer —
+        the background writer would serialize freed memory (observed as
+        glibc heap corruption).  A forced copy makes the snapshot
+        independent of donation, so the writer can run while training
+        continues."""
+        scope = self._scope()
+        if self.main_program is not None:
+            names = [v.name for v in self.main_program.list_vars()
+                     if v.persistable and v.name in scope]
+        else:
+            names = list(scope.keys())
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else None
+        arrays = {n: np.array(scope.get(n), copy=True) for n in names}
+        if obs_on:
+            _obs.tracing.add_span('ckpt.snapshot', t0, time.perf_counter(),
+                                  cat='ckpt', args={'arrays': len(arrays)})
+        return arrays
+
+    def save(self, epoch_id, step_id, extra_meta=None, blocking=None):
+        """Snapshot now, write in the background (unless ``blocking`` or
+        the config says sync).  Returns the directory the checkpoint will
+        land in; ``wait()`` guarantees it is on disk."""
+        self.note_progress(epoch_id, step_id, extra_meta)
+        self._raise_or_warn_write_error()
         cfg = self.config
         os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+        arrays = self._snapshot()
+        meta = {'epoch_id': int(epoch_id), 'step_id': int(step_id),
+                'wall_time': time.time()}
+        rng = getattr(self.executor, 'rng_state', None)
+        if callable(rng):
+            meta['rng_state'] = rng()
+        if extra_meta:
+            meta.update(extra_meta)
         serial = self._serial + 1
+        self._serial = serial
         final_dir = self._dir_of(serial)
-        # write to a temp dir then rename: a crash mid-write can never leave
-        # a half-checkpoint that restore() would pick up
-        tmp = tempfile.mkdtemp(dir=cfg.checkpoint_dir, prefix='.tmp_ckpt_')
+        with self._cond:
+            self._pending += 1
+        self._q.put((serial, final_dir, arrays, meta))
+        if _obs.enabled():
+            _obs.metrics.gauge('ckpt.async_queue_depth').set(self._q.qsize())
+        self._ensure_thread()
+        if blocking is None:
+            blocking = not cfg.async_write
+        if blocking:
+            self.wait()
+        return final_dir
+
+    def _ensure_thread(self):
+        # spawn and retire are both under _cond: a writer deciding to
+        # retire and a save() that just enqueued can never miss each
+        # other (retire re-checks the queue; spawn re-checks liveness)
+        with self._cond:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._writer_loop, name='CheckpointWriter',
+                    daemon=True)
+                self._thread.start()
+
+    def _writer_loop(self):
+        while True:
+            try:
+                job = self._q.get(timeout=5.0)
+            except queue.Empty:
+                with self._cond:
+                    if not self._q.empty():
+                        continue   # a job slipped in: keep serving
+                    self._thread = None   # retire; next save respawns
+                    return
+            try:
+                self._write(*job)
+            except Exception as e:  # noqa: BLE001 - surfaced via wait/save
+                self._write_error = e
+                _obs.metrics.counter('ckpt.write_failures').inc()
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def _write(self, serial, final_dir, arrays, meta):
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else None
+        cfg = self.config
+        # write to a temp dir then rename: a crash mid-write can never
+        # leave a half-checkpoint that restore() would pick up
+        tmp = tempfile.mkdtemp(dir=cfg.checkpoint_dir,
+                               prefix='.tmp_ckpt_%d_' % os.getpid())
         try:
-            fluid_io.save_persistables(self.executor, tmp, self.main_program)
-            meta = {'epoch_id': int(epoch_id), 'step_id': int(step_id)}
-            if extra_meta:
-                meta.update(extra_meta)
+            np.savez(os.path.join(tmp, _ARRAYS), **arrays)
+            # torn-write rehearsal point: tensors on disk, marker not yet
+            _faults.maybe_fail('ckpt_write')
             with open(os.path.join(tmp, _META), 'w') as f:
                 json.dump(meta, f)
             with open(os.path.join(tmp, _SUCCESS), 'w') as f:
@@ -73,14 +220,46 @@ class Checkpointer(object):
             if os.path.isdir(final_dir):
                 shutil.rmtree(final_dir)
             os.rename(tmp, final_dir)
+        except _faults.InjectedFault:
+            # an injected fault simulates a CRASH mid-write: a crashed
+            # process runs no cleanup, so the torn temp dir stays on disk
+            # for the restore-time scan to collect — that scan path is
+            # exactly what the fault exists to exercise
+            raise
         except Exception:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        self._serial = serial
         self._rotate()
-        return final_dir
+        if obs_on:
+            t1 = time.perf_counter()
+            _obs.metrics.counter('ckpt.saves').inc()
+            _obs.metrics.counter('ckpt.save_s').inc(t1 - t0)
+            _obs.metrics.counter('ckpt.bytes_written').inc(
+                os.path.getsize(os.path.join(final_dir, _ARRAYS)))
+            _obs.tracing.add_span('ckpt.write', t0, t1, cat='ckpt',
+                                  args={'serial': serial,
+                                        'step': meta.get('step_id')})
 
-    def _serials(self):
+    def wait(self, timeout=None):
+        """Block until every queued write has hit disk (or failed)."""
+        with self._cond:
+            self._cond.wait_for(lambda: self._pending == 0, timeout=timeout)
+        self._raise_or_warn_write_error()
+
+    def _raise_or_warn_write_error(self):
+        err, self._write_error = self._write_error, None
+        if err is None:
+            return
+        if self.config.strict_writes:
+            raise RuntimeError('checkpoint write failed') from err
+        if not self._warned_write:
+            self._warned_write = True
+            warnings.warn('checkpoint write failed (%r); training continues '
+                          'without it — the previous valid checkpoint is '
+                          'still the restore point' % (err,))
+
+    # ------------------------------------------------------------- scan
+    def _serials(self, include_torn=False):
         d = self.config.checkpoint_dir
         if not os.path.isdir(d):
             return []
@@ -92,7 +271,8 @@ class Checkpointer(object):
                 s = int(name.split('_')[1])
             except (IndexError, ValueError):
                 continue
-            if os.path.exists(os.path.join(d, name, _SUCCESS)):
+            if include_torn or os.path.exists(os.path.join(d, name,
+                                                           _SUCCESS)):
                 out.append(s)
         return sorted(out)
 
@@ -102,21 +282,112 @@ class Checkpointer(object):
         for s in serials[:-keep] if keep > 0 else []:
             shutil.rmtree(self._dir_of(s), ignore_errors=True)
 
+    def _sweep_torn(self):
+        """Delete torn checkpoint dirs (no _SUCCESS) and stale temp dirs.
+        Runs from restore() — after wait(), none of OUR writes are in
+        flight, and a temp dir from a previous (killed) process is by
+        definition dead."""
+        d = self.config.checkpoint_dir
+        if not os.path.isdir(d):
+            return 0
+        dropped = 0
+        valid = set(self._serials())
+        for name in os.listdir(d):
+            path = os.path.join(d, name)
+            if name.startswith('.tmp_ckpt_'):
+                shutil.rmtree(path, ignore_errors=True)
+                dropped += 1
+            elif name.startswith('checkpoint_'):
+                try:
+                    s = int(name.split('_')[1])
+                except (IndexError, ValueError):
+                    continue
+                if s not in valid:
+                    shutil.rmtree(path, ignore_errors=True)
+                    dropped += 1
+        if dropped:
+            _obs.metrics.counter('ckpt.torn_deleted').inc(dropped)
+        return dropped
+
     # ------------------------------------------------------------ restore
     def restore(self):
-        """Load the newest COMPLETE checkpoint (ones without the SUCCESS
-        marker — torn by a failure — are skipped).  Returns its meta dict,
-        or None if nothing to restore."""
+        """Load the newest COMPLETE checkpoint (torn ones — no SUCCESS
+        marker — are deleted), put every array back in the scope, re-arm
+        the executor's RNG/run counters, and return the meta dict (None
+        if nothing to restore)."""
+        try:
+            self.wait()
+        except RuntimeError:
+            pass   # strict-mode write error: restoring is still valid
+        self._sweep_torn()
+        scope = self._scope()
+        keep = None
+        if self.main_program is not None:
+            keep = {v.name for v in self.main_program.list_vars()
+                    if v.persistable}
         for s in reversed(self._serials()):
             ckpt = self._dir_of(s)
             try:
-                fluid_io.load_persistables(self.executor, ckpt,
-                                           self.main_program)
+                with np.load(os.path.join(ckpt, _ARRAYS),
+                             allow_pickle=False) as data:
+                    arrays = {n: data[n] for n in data.files
+                              if keep is None or n in keep}
                 with open(os.path.join(ckpt, _META)) as f:
                     meta = json.load(f)
-                self._serial = s
-                return meta
             except Exception:
                 # corrupt beyond the marker: fall back to the previous one
+                _obs.metrics.counter('ckpt.corrupt_skipped').inc()
                 continue
+            for n, a in arrays.items():
+                scope.set(n, a)
+            rng = meta.get('rng_state')
+            if rng and callable(getattr(self.executor, 'set_rng_state',
+                                        None)):
+                self.executor.set_rng_state(rng)
+            self._serial = s
+            if _obs.enabled():
+                _obs.metrics.counter('ckpt.restores').inc()
+                _obs.tracing.instant('ckpt.restore', cat='ckpt',
+                                     args={'serial': s,
+                                           'step': meta.get('step_id')})
+            return meta
         return None
+
+    # ------------------------------------------------------------ signals
+    def flush_final(self):
+        """One blocking checkpoint at the last recorded progress (the
+        signal handler's body; callable directly for tests)."""
+        if self._last_progress is None:
+            return None
+        epoch_id, step_id, extra = self._last_progress
+        return self.save(epoch_id, step_id, extra, blocking=True)
+
+    def install_signal_handlers(self, signums=(_signal.SIGTERM,
+                                               _signal.SIGINT)):
+        """Arm a final-flush on SIGTERM/SIGINT, then chain to the previous
+        handler (or re-deliver with the default handler, preserving the
+        kill).  Main-thread only — signal.signal raises elsewhere, and a
+        worker thread arming process-global handlers would be a trap."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _handler(signum, frame):
+            try:
+                self.flush_final()
+                _obs.metrics.counter('ckpt.signal_flushes').inc()
+            finally:
+                prev = self._prev_handlers.get(signum, _signal.SIG_DFL)
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    _signal.signal(signum, _signal.SIG_DFL)
+                    os.kill(os.getpid(), signum)
+
+        for signum in signums:
+            self._prev_handlers[signum] = _signal.signal(signum, _handler)
+        return True
+
+    def uninstall_signal_handlers(self):
+        for signum, prev in self._prev_handlers.items():
+            _signal.signal(signum, prev)
+        self._prev_handlers.clear()

@@ -1,0 +1,133 @@
+"""Divergence guard: detect a blown-up step, roll back, skip, retry.
+
+A NaN/Inf step (the executor's fused ``check_nan`` verdict) or a loss
+spike (this module's heuristic) used to simply raise and kill the run —
+hours of soak lost to one bad superbatch.  `RecoveryPolicy` turns the
+raise into a bounded recovery loop:
+
+  1. **rollback** — restore the last good checkpoint (params + optimizer
+     accumulators + RNG counters, via train/checkpoint.py), so the model
+     never trains on top of poisoned state;
+  2. **skip** — the offending superbatch is dropped (`run()` returns
+     None and the caller moves to the next batch);
+  3. **dampen** — optionally scale a named LR variable down;
+  4. **give up** — after ``max_retries`` consecutive divergences the
+     original exception re-raises: a systematically-diverging run should
+     die loudly, not loop forever.
+
+Every action is counted in observability (``recovery.*`` — see
+docs/robustness.md) so a "healthy" run that silently rolled back 50
+times is visible for what it is.
+"""
+import numpy as np
+
+from .. import observability as _obs
+
+__all__ = ['DivergenceError', 'RecoveryPolicy', 'is_divergence']
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged per the loss-spike heuristic (check_nan failures
+    arrive as the executor's own RuntimeError)."""
+
+
+def is_divergence(exc):
+    """Is this exception a numeric divergence the policy may absorb?
+    Anything else (shape errors, OOM, bugs) must propagate untouched."""
+    if isinstance(exc, (DivergenceError, FloatingPointError)):
+        return True
+    return isinstance(exc, RuntimeError) and \
+        str(exc).startswith('check_nan')
+
+
+class RecoveryPolicy(object):
+    """Wrap each training launch: ``out = policy.run(lambda: exe.run(...))``.
+    ``None`` means "this superbatch was rolled back and skipped — feed me
+    the next one"."""
+
+    def __init__(self, checkpointer, max_retries=3, lr_var=None,
+                 lr_scale=None, spike_factor=None, window=32, min_history=5):
+        if checkpointer is None:
+            raise ValueError('RecoveryPolicy needs a Checkpointer to roll '
+                             'back to')
+        self.checkpointer = checkpointer
+        self.max_retries = max(1, int(max_retries))
+        self.lr_var = lr_var
+        self.lr_scale = lr_scale
+        self.spike_factor = float(spike_factor) if spike_factor else None
+        self.window = max(2, int(window))
+        self.min_history = max(2, int(min_history))
+        self._history = []
+        self._consecutive = 0
+
+    # ------------------------------------------------------------ heuristic
+    def check_loss(self, loss):
+        """Loss-spike divergence heuristic: a finite-history median sets
+        the scale; a loss beyond ``spike_factor`` times it (plus a small
+        absolute floor, so near-zero-loss runs don't trip on noise)
+        raises DivergenceError.  Non-finite losses always diverge."""
+        v = float(np.max(np.asarray(loss, dtype=np.float64)))
+        if not np.isfinite(v):
+            raise DivergenceError(
+                'loss is non-finite (%r) — training diverged' % v)
+        if self.spike_factor and len(self._history) >= self.min_history:
+            ref = float(np.median(self._history))
+            limit = self.spike_factor * max(abs(ref), 1e-6)
+            if v > limit:
+                raise DivergenceError(
+                    'loss spike: %.6g > %.3g x median(%.6g) over the last '
+                    '%d steps' % (v, self.spike_factor, ref,
+                                  len(self._history)))
+        self._history.append(v)
+        if len(self._history) > self.window:
+            self._history.pop(0)
+
+    # -------------------------------------------------------------- driver
+    def run(self, fn, loss_index=0):
+        """Run one launch.  Returns its fetches, or None when the launch
+        diverged and was rolled back (the caller skips the superbatch).
+        Re-raises after ``max_retries`` consecutive divergences, and
+        re-raises immediately for non-divergence errors."""
+        try:
+            out = fn()
+            if out and loss_index is not None and self.spike_factor:
+                self.check_loss(out[loss_index])
+            self._consecutive = 0
+            return out
+        except Exception as e:  # noqa: BLE001 - filtered right below
+            if not is_divergence(e):
+                raise
+            self._consecutive += 1
+            _obs.metrics.counter('recovery.divergences').inc()
+            if self._consecutive > self.max_retries:
+                _obs.metrics.counter('recovery.giveups').inc()
+                raise
+            self.rollback(reason=repr(e)[:200])
+            _obs.metrics.counter('recovery.skipped_steps').inc()
+            return None
+
+    def rollback(self, reason=''):
+        """Restore the last good checkpoint into the scope (+ RNG/run
+        counters) and optionally scale the LR down.  Raises if there is
+        no valid checkpoint — recovery without a restore point would mean
+        silently training on poisoned state."""
+        meta = self.checkpointer.restore()
+        if meta is None:
+            _obs.metrics.counter('recovery.no_checkpoint').inc()
+            raise RuntimeError(
+                'divergence recovery failed: no valid checkpoint to roll '
+                'back to (save one before training starts)')
+        _obs.metrics.counter('recovery.rollbacks').inc()
+        _obs.tracing.instant('recovery.rollback', cat='recovery',
+                             args={'to_step': meta.get('step_id'),
+                                   'reason': reason})
+        if self.lr_var and self.lr_scale:
+            scope = self.checkpointer._scope()
+            if self.lr_var in scope:
+                lr = np.asarray(scope.get(self.lr_var))
+                scope.set(self.lr_var, (lr * self.lr_scale).astype(lr.dtype))
+                _obs.metrics.counter('recovery.lr_scaled').inc()
+        # divergences survive rollback history: a spike right after a
+        # rollback should still count toward give-up, but the loss
+        # history predates the poisoned step and stays valid
+        return meta

@@ -17,6 +17,62 @@ def _force_kernel(monkeypatch):
     monkeypatch.setattr(gather, '_MIN_ROWS', _BLOCK)
 
 
+def test_pallas_gather_kernel_runs_no_fallback_possible():
+    """Drive the pallas kernel DIRECTLY in interpret mode — no try/except
+    between this test and the kernel, so an API drift (BENCH_r04's dtype
+    TypeError, the later pltpu.MemorySpace rename) fails HERE instead of
+    silently rerouting production training to jnp.take."""
+    from paddle_tpu.ops.gather import _pallas_gather
+    rng = np.random.RandomState(7)
+    w = jnp.asarray(rng.randn(512, 128), jnp.float32)
+    idx = jnp.asarray(rng.randint(0, 512, (_BLOCK,)), jnp.int32)
+    out = _pallas_gather(w, idx, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(w)[idx],
+                               rtol=1e-6)
+
+
+def test_embedding_gather_no_silent_fallback(monkeypatch):
+    """The full embedding_gather path must run WITHOUT emitting the
+    fallback warning (warnings-as-errors): the kernel path either works
+    or this test fails — degradation can't hide."""
+    import warnings
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.randn(640, 128), jnp.float32)
+    idx = jnp.asarray(rng.randint(0, 640, (_BLOCK,)), jnp.int32)
+    with warnings.catch_warnings():
+        warnings.simplefilter('error')
+        out = embedding_gather(w, idx)
+        jax.grad(lambda w: (embedding_gather(w, idx) ** 2).sum())(w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(w)[idx],
+                               rtol=1e-6)
+
+
+def test_strict_kernels_raises_instead_of_falling_back(monkeypatch):
+    """PT_STRICT_KERNELS=1 turns a kernel failure into a raise with the
+    underlying error; default mode counts kernel.fallbacks."""
+    from paddle_tpu.ops import gather
+    import paddle_tpu.observability as obs
+
+    def _boom(*a, **k):
+        raise ValueError('induced kernel failure')
+
+    monkeypatch.setattr(gather, '_kernel_gather', _boom)
+    rng = np.random.RandomState(4)
+    w = jnp.asarray(rng.randn(640, 128), jnp.float32)
+    idx = jnp.asarray(rng.randint(0, 640, (_BLOCK,)), jnp.int32)
+    before = obs.counters().get('kernel.fallbacks') or 0
+    with pytest.warns(UserWarning, match='embedding_gather'):
+        from paddle_tpu.ops import _fallback
+        monkeypatch.setattr(_fallback, '_warned', set())
+        out = embedding_gather(w, idx)   # degrades to jnp.take, loudly
+    np.testing.assert_allclose(np.asarray(out), np.asarray(w)[idx],
+                               rtol=1e-6)
+    assert (obs.counters().get('kernel.fallbacks') or 0) == before + 1
+    monkeypatch.setenv('PT_STRICT_KERNELS', '1')
+    with pytest.raises(RuntimeError, match='PT_STRICT_KERNELS'):
+        embedding_gather(w, idx)
+
+
 def test_gather_parity_and_grad(monkeypatch):
     from paddle_tpu.ops import gather
     calls = []

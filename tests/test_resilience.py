@@ -1,0 +1,381 @@
+"""Fault-tolerant training runtime (train/checkpoint.py, train/recovery.py):
+async checkpointing, torn-write scanning, SIGKILL/SIGTERM kill-and-resume
+with bitwise loss parity, and divergence rollback."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.testing import faults
+from paddle_tpu.train import (CheckpointConfig, Checkpointer,
+                              RecoveryPolicy, DivergenceError)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _build_model(seed=11):
+    """Tiny classifier with dropout (RNG-dependent) + AMP + Adam (optimizer
+    accumulator state) — the full resume surface."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[4], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, 8, act='relu')
+            h = fluid.layers.dropout(h, 0.3)
+            logits = fluid.layers.fc(h, 3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    main.set_amp(True)
+    return main, startup, loss
+
+
+def _feed_at(i):
+    rng = np.random.RandomState(100 + i)
+    return {'x': rng.rand(4, 4).astype('float32'),
+            'lbl': rng.randint(0, 3, (4, 1)).astype('int64')}
+
+
+# ------------------------------------------------------------ async writer
+
+def test_async_save_restore_roundtrip_with_rng_state(tmp_path):
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(3):
+            exe.run(main, feed=_feed_at(i), fetch_list=[loss])
+        ck.save(0, 2, extra_meta={'note': 'hello'})
+        ck.wait()
+        w = np.asarray(scope.get('fc_0.w_0'))
+        m1 = np.asarray(scope.get('fc_0.w_0_moment1_0'))
+
+    # fresh executor/scope = fresh process stand-in
+    main2, startup2, loss2 = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck2 = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                       exe2, main2, scope=scope2)
+    meta = ck2.restore()
+    assert meta['epoch_id'] == 0 and meta['step_id'] == 2
+    assert meta['note'] == 'hello'
+    # params AND optimizer accumulators restored bit-for-bit
+    np.testing.assert_array_equal(np.asarray(scope2.get('fc_0.w_0')), w)
+    np.testing.assert_array_equal(
+        np.asarray(scope2.get('fc_0.w_0_moment1_0')), m1)
+    # RNG/run counters restored: the next launch's counter continues
+    assert meta['rng_state'] and exe2._pending_counters
+
+
+def test_async_saves_do_not_block_and_rotate_valid_only(tmp_path):
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1,
+                                       max_num_checkpoints=2),
+                      exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=_feed_at(0), fetch_list=[loss])
+        for step in range(5):
+            ck.save(0, step)
+    ck.wait()
+    kept = sorted(d for d in os.listdir(tmp_path)
+                  if d.startswith('checkpoint_'))
+    assert kept == ['checkpoint_3', 'checkpoint_4']
+    assert (obs.counters().get('ckpt.saves') or 0) >= 5
+
+
+def test_write_failure_is_counted_not_fatal(tmp_path):
+    """A torn write (injected ckpt_write fault) must not kill training:
+    counted + warned, and the NEXT save succeeds."""
+    faults.configure('ckpt_write:at=1')
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(0, 0)          # torn by the fault
+        with pytest.warns(UserWarning, match='checkpoint write failed'):
+            ck.wait()          # draining surfaces the async failure
+        ck.save(0, 1)          # ...and the next save succeeds
+        ck.wait()
+    meta = Checkpointer(CheckpointConfig(str(tmp_path)), exe, main,
+                        scope=scope).restore()
+    assert meta['step_id'] == 1
+    assert (obs.counters().get('ckpt.write_failures') or 0) >= 1
+
+
+def test_torn_checkpoint_scan_restores_previous_valid(tmp_path):
+    """The satellite contract: an injected mid-write failure leaves a torn
+    dir; the restorer deletes it and picks the previous valid serial."""
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=_feed_at(0), fetch_list=[loss])
+        ck.save(0, 0)
+        ck.wait()
+        w0 = np.asarray(scope.get('fc_0.w_0'))
+        exe.run(main, feed=_feed_at(1), fetch_list=[loss])
+        faults.configure('ckpt_write:at=1')   # tear the SECOND save
+        ck.save(0, 1)
+        try:
+            ck.wait()
+        except Exception:
+            pass
+    # torn leftovers exist before the scan...
+    leftovers = [d for d in os.listdir(tmp_path)
+                 if d.startswith('.tmp_ckpt_')]
+    assert leftovers, 'fault should have left a torn temp dir'
+    main2, startup2, loss2 = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck2 = Checkpointer(CheckpointConfig(str(tmp_path)), exe2, main2,
+                       scope=scope2)
+    meta = ck2.restore()
+    # ...and are swept by it, with the previous valid serial restored
+    assert meta['step_id'] == 0
+    np.testing.assert_array_equal(np.asarray(scope2.get('fc_0.w_0')), w0)
+    assert not [d for d in os.listdir(tmp_path)
+                if d.startswith('.tmp_ckpt_')]
+    assert (obs.counters().get('ckpt.torn_deleted') or 0) >= 1
+
+
+# --------------------------------------------------------- recovery policy
+
+def test_recovery_rolls_back_and_skips_nan_step(tmp_path):
+    faults.configure('nan_step:at=2')
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(check_nan=True)
+    scope = fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    pol = RecoveryPolicy(ck, max_retries=2)
+    r0 = obs.counters().get('recovery.rollbacks') or 0
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        skipped = []
+        for i in range(5):
+            out = pol.run(lambda: exe.run(main, feed=_feed_at(i),
+                                          fetch_list=[loss]))
+            if out is None:
+                skipped.append(i)
+                continue
+            ck.maybe_save(0, i)
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+    assert skipped == [2]
+    assert all(np.isfinite(losses)) and len(losses) == 4
+    c = obs.counters()
+    assert c.get('recovery.rollbacks') == r0 + 1
+    assert (c.get('faults.injected.nan_step') or 0) >= 1
+
+
+def test_recovery_gives_up_after_bounded_retries(tmp_path):
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(0, 0)
+        ck.wait()
+    pol = RecoveryPolicy(ck, max_retries=2)
+
+    def always_nan():
+        raise RuntimeError('check_nan: non-finite values everywhere')
+
+    assert pol.run(always_nan) is None
+    assert pol.run(always_nan) is None
+    with pytest.raises(RuntimeError, match='check_nan'):
+        pol.run(always_nan)   # third consecutive divergence: re-raise
+    assert (obs.counters().get('recovery.giveups') or 0) >= 1
+
+
+def test_recovery_requires_a_checkpoint():
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig('/nonexistent/ckpt'), exe, main,
+                      scope=scope)
+    pol = RecoveryPolicy(ck, max_retries=3)
+    with pytest.raises(RuntimeError, match='no valid checkpoint'):
+        pol.run(lambda: (_ for _ in ()).throw(
+            RuntimeError('check_nan: boom')))
+
+
+def test_loss_spike_heuristic():
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig('unused_dir'), exe, main,
+                      scope=scope)
+    pol = RecoveryPolicy(ck, spike_factor=10.0, min_history=3)
+    for v in (1.0, 1.1, 0.9, 1.05):
+        pol.check_loss(np.float32(v))
+    with pytest.raises(DivergenceError, match='loss spike'):
+        pol.check_loss(np.float32(50.0))
+    with pytest.raises(DivergenceError, match='non-finite'):
+        pol.check_loss(np.float32(np.nan))
+
+
+def test_non_divergence_errors_propagate_untouched(tmp_path):
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path)), exe, main,
+                      scope=scope)
+    pol = RecoveryPolicy(ck)
+    with pytest.raises(ValueError, match='a real bug'):
+        pol.run(lambda: (_ for _ in ()).throw(ValueError('a real bug')))
+
+
+# ----------------------------------------------------- prefetcher cursor
+
+def test_prefetcher_skip_steps_fast_forwards():
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    feeds = [{'x': np.full((2,), i, np.float32)} for i in range(8)]
+    pf = FeedPrefetcher(iter(feeds), steps=2, to_device=False, skip_steps=4)
+    got = [stacked['x'][:, 0].tolist() for stacked, k in pf]
+    pf.close()
+    assert got == [[4.0, 5.0], [6.0, 7.0]]
+    assert pf.cursor() == {'steps': 8, 'superbatches': 2, 'skipped': 4}
+
+
+# ------------------------------------------------- kill-and-resume (E2E)
+
+_TRAIN_SCRIPT = r"""
+import json, os, signal, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ.setdefault('PT_CACHE', '0')
+sys.path.insert(0, sys.argv[1])
+mode, ckpt_dir = sys.argv[2], sys.argv[3]
+total, kill_at = int(sys.argv[4]), int(sys.argv[5])
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.train import CheckpointConfig, Checkpointer
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = 11
+with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard():
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, 8, act='relu')
+        h = fluid.layers.dropout(h, 0.3)
+        logits = fluid.layers.fc(h, 3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+main.set_amp(True)
+
+def feed_at(i):
+    rng = np.random.RandomState(100 + i)
+    return {'x': rng.rand(4, 4).astype('float32'),
+            'lbl': rng.randint(0, 3, (4, 1)).astype('int64')}
+
+exe, scope = fluid.Executor(), fluid.Scope()
+ck = Checkpointer(CheckpointConfig(ckpt_dir, step_interval=1,
+                                   max_num_checkpoints=3),
+                  exe, main, scope=scope)
+ck.install_signal_handlers()
+meta = ck.restore()
+start = meta['step_id'] + 1 if meta else 0
+K = 2
+losses = []
+with fluid.scope_guard(scope):
+    if meta is None:
+        exe.run(startup)
+    if mode == 'run':
+        for i in range(start, total):
+            l, = exe.run(main, feed=feed_at(i), fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+            ck.save(0, i)                        # async, every step
+            if i == kill_at:
+                os.kill(os.getpid(), signal.SIGKILL)   # preemption, hard
+    else:
+        for s in range(start, total, K):
+            feeds = [feed_at(i) for i in range(s, s + K)]
+            ls, = exe.run_steps(main, feed_list=feeds, steps=K,
+                                fetch_list=[loss])
+            losses.extend(float(v) for v in np.asarray(ls).ravel())
+            ck.save(0, s + K - 1)
+            if s <= kill_at < s + K:
+                os.kill(os.getpid(), signal.SIGKILL)
+print(json.dumps({'start': start, 'losses': losses}))
+"""
+
+
+def _run_train_proc(mode, ckpt_dir, total=8, kill_at=-1, timeout=240,
+                    env_extra=None):
+    env = {k: v for k, v in os.environ.items() if k != 'PT_FAULT'}
+    env.update(env_extra or {})
+    r = subprocess.run(
+        [sys.executable, '-c', _TRAIN_SCRIPT, REPO, mode, str(ckpt_dir),
+         str(total), str(kill_at)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    return r
+
+
+@pytest.mark.parametrize('mode', ['run', 'run_steps'])
+def test_sigkill_and_auto_resume_is_bitwise(tmp_path, mode):
+    """The acceptance contract: SIGKILL a training run mid-epoch, restart
+    with auto-resume, and the combined loss stream is BITWISE equal to an
+    uninterrupted run (CPU, dropout + AMP on) — through both the run and
+    run_steps paths."""
+    # uninterrupted reference (its own checkpoint dir, same code path)
+    full = _run_train_proc(mode, tmp_path / 'full')
+    assert full.returncode == 0, full.stderr
+    ref = json.loads(full.stdout.strip().splitlines()[-1])
+    assert ref['start'] == 0 and len(ref['losses']) == 8
+
+    # killed run: SIGKILL right after step 4's (async) checkpoint submit
+    killed = _run_train_proc(mode, tmp_path / 'ck', kill_at=4)
+    assert killed.returncode == -signal.SIGKILL, (killed.returncode,
+                                                  killed.stderr)
+
+    # resume: picks the newest VALID checkpoint and finishes the epoch
+    resumed = _run_train_proc(mode, tmp_path / 'ck')
+    assert resumed.returncode == 0, resumed.stderr
+    res = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert res['start'] >= 1, 'resume did not find a checkpoint'
+    assert res['start'] <= 5, 'resume overshot the kill point'
+    # bitwise: the resumed tail equals the uninterrupted run's tail
+    assert res['losses'] == ref['losses'][res['start']:], \
+        'resumed run diverged from the uninterrupted one'
+
+
+def test_sigterm_flushes_final_checkpoint_and_resumes_bitwise(tmp_path):
+    """Graceful preemption: the sigterm fault site delivers SIGTERM as
+    step 3 is about to launch; the installed handler flushes one final
+    checkpoint (scope, RNG counters, and recorded progress all consistent
+    at "step 2 complete") before the process dies, and the resumed run
+    continues bitwise."""
+    full = _run_train_proc('run', tmp_path / 'full')
+    ref = json.loads(full.stdout.strip().splitlines()[-1])
+
+    killed = _run_train_proc('run', tmp_path / 'ck',
+                             env_extra={'PT_FAULT': 'sigterm:at=3'})
+    assert killed.returncode != 0
+    resumed = _run_train_proc('run', tmp_path / 'ck')
+    assert resumed.returncode == 0, resumed.stderr
+    res = json.loads(resumed.stdout.strip().splitlines()[-1])
+    # the flush covered steps 0..2, so resume starts exactly at step 3 —
+    # no step lost, no step double-trained
+    assert res['start'] == 3, res
+    assert res['losses'] == ref['losses'][3:]

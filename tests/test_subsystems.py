@@ -80,6 +80,7 @@ def test_checkpointer_save_restore_rotate(tmp_path):
         ck = Checkpointer(cfg, exe, main)
         for step in range(4):
             ck.save(epoch_id=0, step_id=step)
+        ck.wait()   # saves are async: drain the background writer
         w_saved = np.asarray(scope.vars['w'])
         # rotation: only 2 newest kept
         kept = [d for d in os.listdir(ckpt_dir)
@@ -106,6 +107,7 @@ def test_checkpointer_skips_torn_checkpoint(tmp_path):
         ck.save(0, 1)
         w1 = np.asarray(scope.vars['w'])
         d2 = ck.save(0, 2)
+        ck.wait()   # saves are async: drain the background writer
         # simulate failure mid-write of the newest: drop its SUCCESS marker
         os.remove(os.path.join(d2, '_SUCCESS'))
         scope.vars['w'] = scope.vars['w'] * 0

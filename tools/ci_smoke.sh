@@ -104,6 +104,45 @@ else
     ruff_rc=0
 fi
 
+echo "== ci_smoke: fault-injection soak =="
+# resilience gate (docs/robustness.md): a short training run survives the
+# armed PT_FAULT matrix — NaN burst (divergence rollback), torn checkpoint
+# write, compile-cache read/write OSErrors (retry_with_backoff), prefetch
+# stall — and proves it with counters: recovery.rollbacks > 0,
+# faults.injected > 0, zero post-recovery retraces, zero steady-state
+# pipeline stalls.  Phase 2 rehearses preemption: SIGTERM mid-run (the
+# handler flushes a final checkpoint), then a fresh process must
+# auto-resume from it and finish.
+soak_dir=$(mktemp -d /tmp/pt_soak.XXXXXX)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=1 \
+    PT_CACHE_DIR="$soak_dir/cache" \
+    PT_FAULT="nan_step:at=4,ckpt_write:at=2,cache_read:at=1,cache_write:at=1,prefetch_stall:at=1:s=0.05" \
+    python tools/fault_soak.py --steps 12 --ckpt "$soak_dir/ckpt" \
+    --assert-recovery
+soak_rc=$?
+if [ "$soak_rc" -ne 0 ]; then
+    echo "ci_smoke: fault-injection soak FAILED (rc=$soak_rc)"
+fi
+
+echo "== ci_smoke: preemption (SIGTERM) + auto-resume =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 \
+    PT_FAULT="sigterm:at=6" \
+    python tools/fault_soak.py --steps 12 --ckpt "$soak_dir/ckpt2"
+term_rc=$?
+if [ "$term_rc" -eq 0 ]; then
+    echo "ci_smoke: SIGTERM fault did not terminate the soak (rc=0)"
+    resume_rc=1
+else
+    timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 \
+        python tools/fault_soak.py --steps 12 --ckpt "$soak_dir/ckpt2" \
+        --expect-resume
+    resume_rc=$?
+fi
+if [ "$resume_rc" -ne 0 ]; then
+    echo "ci_smoke: preemption auto-resume FAILED (rc=$resume_rc)"
+fi
+rm -rf "$soak_dir"
+
 echo "== ci_smoke: tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -122,11 +161,16 @@ smoke_cache=$(mktemp -d /tmp/pt_smoke_cache.XXXXXX)
 trap 'rm -rf "$smoke_cache"' EXIT
 bench_env="JAX_PLATFORMS=cpu BENCH_PROBE_TIMEOUT=60 BENCH_B=2 BENCH_T=16 \
     BENCH_RESNET_B=1 BENCH_STEPS_PER_LAUNCH=2 PT_CACHE=1 PT_CACHE_DIR=$smoke_cache"
+# on failure the last stdout line is bench.py's structured
+# {"error": ..., "stage": ...} tail — echo it so a dead round still
+# leaves a diagnosable artifact in the CI log
 bench_out=$(timeout -k 10 1200 env $bench_env python bench.py) \
-    || { echo "ci_smoke: bench.py (cold) FAILED"; exit 1; }
+    || { echo "ci_smoke: bench.py (cold) FAILED"; \
+         echo "$bench_out" | tail -1; exit 1; }
 echo "$bench_out"
 bench_out2=$(timeout -k 10 1200 env $bench_env python bench.py) \
-    || { echo "ci_smoke: bench.py (warm) FAILED"; exit 1; }
+    || { echo "ci_smoke: bench.py (warm) FAILED"; \
+         echo "$bench_out2" | tail -1; exit 1; }
 echo "$bench_out2"
 
 python - "$bench_out" "$bench_out2" <<'EOF'
@@ -157,7 +201,8 @@ tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'compile_cache_hits', 'compile_cache_misses', 'tail_splits',
                 'trace_s', 'backend_compile_s', 'program_op_count_raw',
                 'program_op_count_opt', 'opt_pass_ms', 'opt_ops_fused',
-                'stall_count', 'prefetch_starvation_s', 'fetch_sync_s']
+                'stall_count', 'prefetch_starvation_s', 'fetch_sync_s',
+                'kernel_fallbacks']
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
     sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
@@ -169,6 +214,10 @@ for label, t in (('cold', tel), ('warm', rec2['telemetry'])):
         sys.exit('ci_smoke: %s bench reports %d retrace(s) AFTER warmup — '
                  'the fused loop recompiled mid-measurement (retrace '
                  'regression)' % (label, t['retraces']))
+if tel['kernel_fallbacks'] > 0:
+    sys.exit('ci_smoke: %d kernel fallback(s) — a pallas kernel silently '
+             'degraded to its composed path (PT_STRICT_KERNELS=1 shows '
+             'the raw error)' % tel['kernel_fallbacks'])
 if tel['compiles'] < 1:
     sys.exit('ci_smoke: telemetry.compiles=%r — executor instrumentation '
              'recorded no compiles at all' % tel['compiles'])
@@ -208,4 +257,5 @@ if [ "$t1_rc" -ne 0 ]; then
 fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
-    [ "$opt_gate_rc" -eq 0 ]
+    [ "$opt_gate_rc" -eq 0 ] && [ "$soak_rc" -eq 0 ] && \
+    [ "$resume_rc" -eq 0 ]

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""Fault-injection soak: a short training run that SURVIVES the armed
+PT_FAULT matrix and proves it with counters.
+
+Drives the full resilience stack end-to-end — async Checkpointer (+
+signal flush), RecoveryPolicy rollback, FeedPrefetcher, run_steps fused
+launches, the executor's fused check_nan verdict — under whatever faults
+the caller armed via PT_FAULT (see paddle_tpu/testing/faults.py for the
+site table).  Used by tools/ci_smoke.sh:
+
+  phase 1: in-process faults (nan_step, ckpt_write, cache_read,
+           cache_write, prefetch_stall) — must COMPLETE, with
+           recovery.rollbacks > 0, faults.injected > 0, all losses
+           finite, zero post-recovery retraces, zero pipeline stalls
+           (--assert-recovery);
+  phase 2: PT_FAULT=sigterm:at=K kills the process mid-run (the signal
+           handler flushes a final checkpoint); a second invocation with
+           --expect-resume must restore it and finish the run.
+
+Prints one JSON line: {"steps_done": ..., "start": ..., "counters": ...}.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=12)
+    ap.add_argument('--launch-k', type=int, default=2)
+    ap.add_argument('--ckpt', required=True)
+    ap.add_argument('--assert-recovery', action='store_true',
+                    help='require rollbacks>0, injections>0, zero '
+                         'post-recovery retraces, zero pipeline stalls')
+    ap.add_argument('--expect-resume', action='store_true',
+                    help='require a valid checkpoint to resume from')
+    args = ap.parse_args()
+
+    import numpy as np
+    import paddle_tpu as fluid
+    import paddle_tpu.observability as obs
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    from paddle_tpu.train import (CheckpointConfig, Checkpointer,
+                                  RecoveryPolicy)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = 17
+    with fluid.program_guard(main_prog, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, 16, act='relu')
+            h = fluid.layers.dropout(h, 0.2)
+            logits = fluid.layers.fc(h, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    main_prog.set_amp(True)
+
+    def feed_at(i):
+        rng = np.random.RandomState(1000 + i)
+        return {'x': rng.rand(8, 8).astype('float32'),
+                'lbl': rng.randint(0, 4, (8, 1)).astype('int64')}
+
+    exe = fluid.Executor(check_nan=True)
+    scope = fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(args.ckpt, step_interval=1,
+                                       max_num_checkpoints=3),
+                      exe, main_prog, scope=scope)
+    ck.install_signal_handlers()
+    meta = ck.restore()
+    start = meta['step_id'] + 1 if meta else 0
+    if args.expect_resume and (meta is None or start < 1):
+        sys.exit('fault_soak: --expect-resume but no valid checkpoint '
+                 'found in %s (meta=%r)' % (args.ckpt, meta))
+
+    policy = RecoveryPolicy(ck, max_retries=4)
+    K = args.launch_k
+    pf = FeedPrefetcher((feed_at(i) for i in range(start, args.steps)),
+                        steps=K, to_device=False)
+    losses = []
+    skipped = 0
+    retrace_mark = None   # executor.retraces at the first rollback
+    stall_mark = None     # executor.stall_count once steady state begins
+    with fluid.scope_guard(scope):
+        if meta is None:
+            exe.run(startup)
+            # restore point BEFORE any step: recovery can roll back even
+            # a first-step divergence
+            ck.save(0, -1)
+            ck.wait()
+        step = start
+        for stacked, k in pf:
+            out = policy.run(lambda: exe.run_steps(
+                main_prog, feed_list=stacked, steps=k, fetch_list=[loss]))
+            if stall_mark is None:
+                # steady state starts AFTER the first fused launch: the
+                # cold-start gap (startup program, initial blocking save,
+                # the injected prefetch_stall fault) is not what the
+                # async-checkpointing stall budget is about
+                stall_mark = int(
+                    obs.counters().get('executor.stall_count') or 0)
+            if out is None:
+                skipped += k
+                step += k
+                # everything after a rollback must reuse the cached
+                # executables: restored numpy params have identical
+                # specs, so ANY retrace from here on is a regression
+                if retrace_mark is None:
+                    retrace_mark = int(
+                        obs.counters().get('executor.retraces') or 0)
+                continue
+            losses.extend(float(v) for v in np.asarray(out[0]).ravel())
+            ck.maybe_save(0, step + k - 1)
+            step += k
+        ck.wait()
+    c = obs.counters()
+    retraces_after_recovery = 0 if retrace_mark is None else \
+        int(c.get('executor.retraces') or 0) - retrace_mark
+    steady_stalls = 0 if stall_mark is None else \
+        int(c.get('executor.stall_count') or 0) - stall_mark
+
+    rec = {
+        'start': start,
+        'steps_done': len(losses),
+        'steps_skipped': skipped,
+        'losses_finite': bool(np.all(np.isfinite(losses))),
+        'counters': {k: c.get(k) or 0 for k in (
+            'faults.injected', 'recovery.rollbacks', 'recovery.divergences',
+            'recovery.skipped_steps', 'ckpt.saves', 'ckpt.write_failures',
+            'ckpt.torn_deleted', 'ckpt.restores', 'retry.attempts',
+            'executor.retraces', 'executor.stall_count',
+            'prefetch.starvation_count', 'kernel.fallbacks')},
+        'retraces_after_recovery': retraces_after_recovery,
+        'steady_state_stalls': steady_stalls,
+    }
+    print(json.dumps(rec))
+
+    if not rec['losses_finite']:
+        sys.exit('fault_soak: non-finite loss escaped the recovery policy')
+    if args.assert_recovery:
+        cc = rec['counters']
+        if cc['faults.injected'] < 1:
+            sys.exit('fault_soak: no faults injected — PT_FAULT matrix '
+                     'not armed?')
+        if cc['recovery.rollbacks'] < 1:
+            sys.exit('fault_soak: no rollbacks — the nan_step fault did '
+                     'not exercise recovery')
+        if rec['retraces_after_recovery'] > 0:
+            sys.exit('fault_soak: %d retrace(s) after rollback — restored '
+                     'state no longer matches the compiled executables'
+                     % rec['retraces_after_recovery'])
+        if rec['steady_state_stalls'] > 0:
+            sys.exit('fault_soak: %d steady-state pipeline stall(s) — '
+                     'async checkpointing (or recovery) is blocking the '
+                     'step loop' % rec['steady_state_stalls'])
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
