@@ -55,6 +55,7 @@ from . import dataset  # noqa
 from . import imperative  # noqa
 from . import debugger  # noqa
 from . import inference  # noqa
+from . import serving  # noqa
 from . import train  # noqa
 from . import average  # noqa
 from . import evaluator  # noqa

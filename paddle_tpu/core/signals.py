@@ -1,0 +1,106 @@
+"""Process-global chained signal-handler installation.
+
+Two subsystems want a say in what happens on SIGTERM/SIGINT: the
+training runtime flushes a final checkpoint (train/checkpoint.py) and
+the serving runtime drains in-flight requests (serving/engine.py).
+Python gives one handler slot per signal per process, so both chain:
+each installer saves the previous handler and invokes it after its own
+work.  Chaining by hand is easy to get wrong in exactly two ways this
+module exists to prevent:
+
+  * **Double-chain.**  Installing the same owner twice (Trainer.train
+    called again, an engine restarted) must not chain a handler to an
+    older copy of itself — the flush/drain would run twice per signal.
+    Installation is idempotent per ``(token, signum)``.
+  * **Worker-thread install.**  ``signal.signal`` raises ``ValueError``
+    off the main thread.  A serving worker thread arming process-global
+    handlers would also be a trap even if it worked — so the install is
+    detected, warned about ONCE, and skipped instead of crashing.
+"""
+import os
+import signal as _signal
+import threading
+import warnings
+
+__all__ = ['install', 'uninstall', 'installed', 'chain_previous',
+           'on_main_thread']
+
+_LOCK = threading.Lock()
+_INSTALLED = {}          # (token, signum) -> (handler, prev_handler)
+_WARNED_THREAD = [False]
+
+
+def on_main_thread():
+    return threading.current_thread() is threading.main_thread()
+
+
+def install(token, signums, make_handler):
+    """Install chained handlers for ``signums`` under owner ``token``.
+
+    ``make_handler(signum, prev) -> handler`` builds the handler given
+    the previously installed one (chain to it via :func:`chain_previous`).
+    Returns ``None`` when skipped off the main thread (warned once per
+    process), else ``{signum: prev_handler}`` for the signums newly
+    installed — already-installed ``(token, signum)`` pairs are skipped
+    silently, so a second install never chains a handler to itself.
+    """
+    if not on_main_thread():
+        if not _WARNED_THREAD[0]:
+            _WARNED_THREAD[0] = True
+            warnings.warn(
+                'signal handlers can only be installed from the main '
+                'thread; skipping install for %r (signal.signal raises '
+                'ValueError on worker threads)' % (token,),
+                RuntimeWarning, stacklevel=2)
+        return None
+    out = {}
+    with _LOCK:
+        for signum in signums:
+            if (token, signum) in _INSTALLED:
+                continue
+            prev = _signal.getsignal(signum)
+            handler = make_handler(signum, prev)
+            _signal.signal(signum, handler)
+            _INSTALLED[(token, signum)] = (handler, prev)
+            out[signum] = prev
+    return out
+
+
+def installed(token, signum=None):
+    """Is owner ``token`` currently installed (for ``signum``, or any)?"""
+    with _LOCK:
+        if signum is not None:
+            return (token, signum) in _INSTALLED
+        return any(tok == token for tok, _ in _INSTALLED)
+
+
+def uninstall(token):
+    """Restore the pre-install handler for every signum owned by
+    ``token`` — but only where our handler is still the active one (a
+    later installer chained on top of us keeps its chain intact)."""
+    main = on_main_thread()
+    with _LOCK:
+        for (tok, signum), (handler, prev) in list(_INSTALLED.items()):
+            if tok != token:
+                continue
+            if main and _signal.getsignal(signum) is handler:
+                _signal.signal(signum, prev)
+            del _INSTALLED[(tok, signum)]
+
+
+def chain_previous(prev, signum, frame, redeliver=True):
+    """Invoke the handler that was active before ours.
+
+    Callable → call it.  ``SIG_IGN`` → nothing.  Default/None →
+    ``redeliver=True`` restores ``SIG_DFL`` and re-raises the signal so
+    the process still dies from a SIGTERM it was sent (the checkpoint
+    flush path); ``redeliver=False`` swallows it so a graceful-drain
+    handler can let the application exit on its own schedule."""
+    if prev is _signal.SIG_IGN:
+        return
+    if callable(prev):
+        prev(signum, frame)
+        return
+    if redeliver:
+        _signal.signal(signum, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)

@@ -83,6 +83,12 @@ class FeedBucketer(object):
         if pad_mode not in ('edge', 'zero'):
             raise ValueError("pad_mode must be 'edge' or 'zero'")
         self.pad_mode = pad_mode
+        # distinct batch boundaries this instance has materialized — each
+        # one is a compile signature, so unbounded growth here (huge
+        # batches quantizing to ever-new multiples of the top boundary)
+        # is a compile-cache leak; metered as the bucketer.bucket_count
+        # gauge and readable via bucket_count()
+        self._buckets_seen = set()
 
     def boundary(self, n):
         """Smallest boundary >= n; beyond the largest boundary, the next
@@ -152,13 +158,21 @@ class FeedBucketer(object):
             mask = np.zeros((target, 1), np.float32)
             mask[:batch] = 1.0
             out[self.mask_name] = mask
+        self._buckets_seen.add(target)
         if _obs.enabled():
+            _obs.metrics.gauge('bucketer.bucket_count').set(
+                len(self._buckets_seen))
             _obs.metrics.counter('bucketer.batches').inc()
             _obs.metrics.counter('bucketer.rows_real').inc(batch)
             _obs.metrics.counter('bucketer.rows_pad').inc(target - batch)
             _obs.metrics.gauge('bucketer.pad_waste').set(
                 (target - batch) / float(target))
         return out, batch
+
+    def bucket_count(self):
+        """Distinct batch boundaries materialized so far (== the
+        ``bucketer.bucket_count`` gauge)."""
+        return len(self._buckets_seen)
 
     def covered_axes(self, name, lod_level=0):
         """Which axes of feed `name` this bucketer stabilizes onto bucket

@@ -9,6 +9,7 @@ lowered to ONE XLA executable — XLA *is* the analysis/fusion pass — and can 
 exported ahead-of-time as serialized StableHLO via jax.export.
 """
 import os
+import threading
 
 import numpy as np
 
@@ -93,8 +94,14 @@ class Predictor(object):
         # per-shape AOT executables, warm-started from the persistent
         # cache (core/compile_cache.py) when PT_CACHE is on: a freshly
         # started serving process skips trace AND compile for every feed
-        # shape it has ever seen on this machine
+        # shape it has ever seen on this machine.  Concurrent predicts
+        # (the serving engine's dispatch thread + direct callers) share
+        # the dict under a lock with single-flight per shape: the first
+        # thread to see a cold shape compiles, the rest wait for its
+        # result instead of duplicating a multi-second compile.
         self._compiled = {}
+        self._compile_lock = threading.Lock()
+        self._inflight = {}   # shape_key -> Event set when compile ends
 
     def _cast_params_bf16(self):
         import jax.numpy as jnp
@@ -122,9 +129,29 @@ class Predictor(object):
         if not _cc.disk_enabled():
             return self._fn, self._params_in
         shape_key = tuple((n,) + _feed_spec(feeds[n]) for n in sorted(feeds))
-        call = self._compiled.get(shape_key)
-        if call is not None:
+        while True:
+            with self._compile_lock:
+                call = self._compiled.get(shape_key)
+                if call is not None:
+                    return call, self._params_in
+                ev = self._inflight.get(shape_key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[shape_key] = ev
+                    break   # this thread owns the compile
+            # another thread is compiling this shape: wait, then re-check
+            # (its failure leaves the cache cold; the retry compiles here)
+            _obs.metrics.counter('predictor.single_flight_waits').inc()
+            ev.wait()
+        try:
+            call = self._compile_shape(shape_key, feeds)
             return call, self._params_in
+        finally:
+            with self._compile_lock:
+                self._inflight.pop(shape_key, None)
+            ev.set()
+
+    def _compile_shape(self, shape_key, feeds):
         _cc.ensure_xla_cache_backstop()
         params = {n: self._scope.vars[n] for n in self._params_in}
         fp = _cc.launch_fingerprint(
@@ -141,8 +168,9 @@ class Predictor(object):
                                    meta={'kind': 'predictor'})
         else:
             _obs.metrics.counter('compile_cache.disk_hits').inc()
-        self._compiled[shape_key] = call
-        return call, self._params_in
+        with self._compile_lock:
+            self._compiled[shape_key] = call
+        return call
 
     def run(self, feeds):
         """feeds: dict name->array, or list of arrays in input-name order.

@@ -1,0 +1,31 @@
+"""paddle_tpu.serving — robust inference serving runtime.
+
+Continuous batching over the Predictor/AOT-cache/FeedBucketer stack
+with admission control, per-request deadlines, load shedding, a circuit
+breaker, and chaos-tested graceful degradation.  See docs/serving.md.
+
+    from paddle_tpu import serving
+
+    engine = serving.ServingEngine.from_predictor(
+        predictor, bucketer=fluid.FeedBucketer(boundaries=[1, 2, 4, 8]),
+        config=serving.ServingConfig(max_queue=128,
+                                     overflow_policy='shed_oldest',
+                                     default_timeout_s=0.5))
+    engine.start()
+    engine.install_signal_handlers()          # SIGTERM -> graceful drain
+    result = engine.infer({'x': batch}, timeout_s=0.2)
+    if result.ok:
+        probs = result.outputs[0]
+"""
+from .admission import TokenBucket, OVERFLOW_POLICIES  # noqa
+from .breaker import CircuitBreaker, CLOSED, HALF_OPEN, OPEN  # noqa
+from .engine import (ServingConfig, ServingEngine, ServeFuture,  # noqa
+                     ServeResult, STARTING, READY, DEGRADED, DRAINING,
+                     STOPPED, OK, REJECTED, SHED, DEADLINE_EXCEEDED,
+                     ERROR)
+
+__all__ = ['ServingConfig', 'ServingEngine', 'ServeFuture', 'ServeResult',
+           'TokenBucket', 'CircuitBreaker', 'OVERFLOW_POLICIES',
+           'STARTING', 'READY', 'DEGRADED', 'DRAINING', 'STOPPED',
+           'OK', 'REJECTED', 'SHED', 'DEADLINE_EXCEEDED', 'ERROR',
+           'CLOSED', 'HALF_OPEN', 'OPEN']

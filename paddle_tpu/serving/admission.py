@@ -1,0 +1,55 @@
+"""Admission control primitives for the serving engine.
+
+Admission is the cheap front door: every check here runs in the
+submitting client's thread, before a request costs the dispatch thread
+anything.  The engine composes three gates —
+
+  * request validation (shape sanity: batch >= 1, within the largest
+    bucket boundary),
+  * a :class:`TokenBucket` rate limiter (``rate_qps`` / ``rate_burst``),
+  * bounded-queue overflow policy (``reject`` / ``block`` /
+    ``shed_oldest``)
+
+— and every refusal is a TERMINAL reply with a named reason, never a
+silent drop (``serving.rejected.<reason>`` counters).
+"""
+import threading
+import time
+
+__all__ = ['TokenBucket', 'OVERFLOW_POLICIES']
+
+OVERFLOW_POLICIES = ('reject', 'block', 'shed_oldest')
+
+
+class TokenBucket(object):
+    """Classic token bucket: ``qps`` tokens/second refill up to a
+    ``burst`` ceiling; an admission costs one token.  The clock is
+    injectable so tests (and deterministic soaks) can drive it."""
+
+    def __init__(self, qps, burst=None, clock=time.monotonic):
+        qps = float(qps)
+        if qps <= 0:
+            raise ValueError('rate_qps must be > 0, got %r' % qps)
+        self.qps = qps
+        self.burst = float(burst if burst is not None else max(1.0, qps))
+        self._tokens = self.burst
+        self._clock = clock
+        self._last = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n=1.0):
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def tokens(self):
+        with self._lock:
+            now = self._clock()
+            return min(self.burst,
+                       self._tokens + (now - self._last) * self.qps)

@@ -1,0 +1,120 @@
+"""Circuit breaker for the serving dispatch path.
+
+The engine keeps superbatching while the backend is healthy.  Two
+distinct failure smells trip the breaker:
+
+  * **Consecutive batch failures** — the backend raising on every
+    dispatch (a wedged device, a poisoned executable).  Re-batching
+    into the same wall just multiplies blast radius.
+  * **Compile-cache-miss storms** — a run of batches that each needed a
+    cold compile (a client population suddenly sending never-seen
+    shapes).  A 60-second compile at superbatch size stalls EVERY
+    queued request behind it; serving cold traffic one request at a
+    time bounds the damage to the cold requests themselves.
+
+States follow the classic three-state machine:
+
+  ``closed``     normal superbatching
+  ``open``       tripped; the engine serves the SLOW PATH (one request
+                 per dispatch) until ``cooldown_s`` elapses
+  ``half_open``  cooldown elapsed; the next dispatch is a normal-sized
+                 probe batch — success closes the breaker
+                 (``serving.breaker_recoveries``), failure re-opens it
+
+All transitions are counted (``serving.breaker_trips`` /
+``serving.breaker_recoveries``) and the current state is exported as the
+``serving.breaker_state`` gauge (0=closed, 1=half_open, 2=open).
+"""
+import threading
+import time
+
+from .. import observability as _obs
+
+__all__ = ['CircuitBreaker', 'CLOSED', 'OPEN', 'HALF_OPEN']
+
+CLOSED, HALF_OPEN, OPEN = 'closed', 'half_open', 'open'
+_STATE_GAUGE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker(object):
+    def __init__(self, failure_threshold=3, storm_threshold=3,
+                 cooldown_s=0.25, clock=time.monotonic):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.storm_threshold = max(1, int(storm_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consec_failures = 0
+        self._consec_cold = 0
+        self._opened_at = None
+        self.trips = 0
+        self.recoveries = 0
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state
+
+    def _set_state(self, state):
+        self._state = state
+        _obs.metrics.gauge('serving.breaker_state').set(_STATE_GAUGE[state])
+
+    def _trip(self, reason):
+        if self._state != OPEN:
+            self.trips += 1
+            _obs.metrics.counter('serving.breaker_trips').inc()
+            _obs.tracing.instant('serving.breaker_trip', cat='serving',
+                                 args={'reason': reason})
+        self._set_state(OPEN)
+        self._opened_at = self._clock()
+        self._consec_failures = 0
+        self._consec_cold = 0
+
+    def record_failure(self):
+        """A dispatched batch raised."""
+        with self._lock:
+            if self._state == HALF_OPEN:
+                self._trip('probe_failed')
+                return
+            self._consec_failures += 1
+            if self._consec_failures >= self.failure_threshold:
+                self._trip('consecutive_failures')
+
+    def record_cold(self):
+        """A dispatched batch needed a cold compile."""
+        with self._lock:
+            if self._state == OPEN:
+                return
+            self._consec_cold += 1
+            if self._consec_cold >= self.storm_threshold:
+                self._trip('compile_storm')
+
+    def record_success(self, cold=False):
+        """A dispatched batch completed (``cold``: it also compiled —
+        a success for its requests, still a storm signal)."""
+        with self._lock:
+            self._consec_failures = 0
+            if not cold:
+                self._consec_cold = 0
+            if self._state == HALF_OPEN:
+                self._set_state(CLOSED)
+                self.recoveries += 1
+                _obs.metrics.counter('serving.breaker_recoveries').inc()
+                _obs.tracing.instant('serving.breaker_recovered',
+                                     cat='serving')
+
+    def mode(self):
+        """Dispatch decision, one call per batch: ``'normal'`` (closed),
+        ``'slow'`` (open, serve one request per dispatch), ``'probe'``
+        (half-open: normal-sized batch whose outcome settles the
+        state)."""
+        with self._lock:
+            if self._state == CLOSED:
+                return 'normal'
+            if self._state == OPEN:
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._set_state(HALF_OPEN)
+                    return 'probe'
+                return 'slow'
+            return 'probe'

@@ -41,6 +41,18 @@ Instrumented sites (kept in sync with docs/robustness.md):
                    before packing a superbatch (data_feeder.py)
   ``sigterm``      the process sends itself SIGTERM after step N
                    completes (core/executor.py) — preemption rehearsal
+  ``serve_dispatch``  the serving engine's batch dispatch raises —
+                   every request in the batch gets an error reply and
+                   the circuit breaker counts a failure
+                   (serving/engine.py)
+  ``serve_slow_batch``  the dispatch thread sleeps ``s`` seconds before
+                   running a batch — a latency spike the p99 SLO sees
+  ``queue_overflow``  one admission decision is forced to treat the
+                   request queue as full, exercising the configured
+                   overflow policy (reject / shed-oldest) on demand
+  ``compile_storm``  a batch is treated as a cold-compile: the dispatch
+                   thread sleeps ``s`` seconds and the breaker counts a
+                   cold batch — enough consecutive ones trip it
   ===============  ====================================================
 """
 import os
@@ -55,7 +67,8 @@ __all__ = ['configure', 'reset', 'any_active', 'active', 'fire', 'fire_in',
            'InjectedFault', 'SITES']
 
 SITES = ('ckpt_write', 'cache_read', 'cache_write', 'io_read', 'io_write',
-         'nan_step', 'prefetch_stall', 'sigterm')
+         'nan_step', 'prefetch_stall', 'sigterm', 'serve_dispatch',
+         'serve_slow_batch', 'queue_overflow', 'compile_storm')
 
 
 class InjectedFault(OSError):
@@ -189,11 +202,16 @@ def maybe_fail(site, step=None, exc=None):
 
 
 def maybe_sleep(site):
-    """Stall-type sites: sleep the armed duration instead of raising."""
+    """Stall-type sites: sleep the armed duration instead of raising.
+    Returns True when the fault fired (serving's dispatch loop uses this
+    to attribute the stall — e.g. count a ``compile_storm`` batch as a
+    cold one for the circuit breaker)."""
     _ensure()
     spec = _ACTIVE.get(site)
     if spec is not None and fire(site):
         time.sleep(spec.sleep_s)
+        return True
+    return False
 
 
 def maybe_kill(site='sigterm', step=None, count=1, sig=signal.SIGTERM):

@@ -46,6 +46,7 @@ import warnings
 import numpy as np
 
 from .. import observability as _obs
+from ..core import signals as _signals
 from ..testing import faults as _faults
 
 __all__ = ['CheckpointConfig', 'Checkpointer']
@@ -366,28 +367,29 @@ class Checkpointer(object):
                                                _signal.SIGINT)):
         """Arm a final-flush on SIGTERM/SIGINT, then chain to the previous
         handler (or re-deliver with the default handler, preserving the
-        kill).  Main-thread only — signal.signal raises elsewhere, and a
-        worker thread arming process-global handlers would be a trap."""
-        if threading.current_thread() is not threading.main_thread():
+        kill).  Installation goes through core/signals.py: idempotent —
+        a second install (Trainer.train called again) never chains a
+        handler to an older copy of itself — and main-thread-guarded
+        (a serving worker thread calling this warns once and skips
+        instead of crashing in ``signal.signal``).  The serving engine's
+        drain handler composes by chaining: installed after this one, it
+        drains first and then the checkpoint flush still runs."""
+
+        def make(signum, prev):
+            def _handler(s, frame):
+                try:
+                    self.flush_final()
+                    _obs.metrics.counter('ckpt.signal_flushes').inc()
+                finally:
+                    _signals.chain_previous(prev, s, frame, redeliver=True)
+            return _handler
+
+        installed = _signals.install(('ckpt', id(self)), signums, make)
+        if installed is None:
             return False
-
-        def _handler(signum, frame):
-            try:
-                self.flush_final()
-                _obs.metrics.counter('ckpt.signal_flushes').inc()
-            finally:
-                prev = self._prev_handlers.get(signum, _signal.SIG_DFL)
-                if callable(prev):
-                    prev(signum, frame)
-                else:
-                    _signal.signal(signum, _signal.SIG_DFL)
-                    os.kill(os.getpid(), signum)
-
-        for signum in signums:
-            self._prev_handlers[signum] = _signal.signal(signum, _handler)
+        self._prev_handlers.update(installed)
         return True
 
     def uninstall_signal_handlers(self):
-        for signum, prev in self._prev_handlers.items():
-            _signal.signal(signum, prev)
+        _signals.uninstall(('ckpt', id(self)))
         self._prev_handlers.clear()

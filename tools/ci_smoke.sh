@@ -143,6 +143,26 @@ if [ "$resume_rc" -ne 0 ]; then
 fi
 rm -rf "$soak_dir"
 
+echo "== ci_smoke: serving soak (continuous batching under chaos) =="
+# serving gate (docs/serving.md): serve_soak drives a real
+# Predictor-backed ServingEngine with closed+open-loop traffic while
+# four fault sites are armed — slow batches, consecutive dispatch
+# failures (the breaker must trip AND recover), a compile-cache-miss
+# storm, and a mid-run SIGTERM that must turn into a graceful drain.
+# --assert-slo fails the gate unless p99 is finite, every admitted
+# request got a terminal reply (admitted == completed + errors +
+# deadline_exceeded + shed), serving.deadlocks == 0, and the shed rate
+# stays under the ceiling.
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 \
+    PT_FAULT="serve_slow_batch:at=1:times=1:s=0.05,serve_dispatch:at=2:times=3,compile_storm:at=12:times=3:s=0.03,queue_overflow:at=30:times=2,sigterm:at=70" \
+    python tools/serve_soak.py --requests 80 --qps 150 --clients 2 \
+    --deadline-ms 4000 --shed-ceiling 0.35 \
+    --assert-slo --expect-breaker --expect-drain
+serve_rc=$?
+if [ "$serve_rc" -ne 0 ]; then
+    echo "ci_smoke: serving soak FAILED (rc=$serve_rc)"
+fi
+
 echo "== ci_smoke: tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -258,4 +278,4 @@ fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$soak_rc" -eq 0 ] && \
-    [ "$resume_rc" -eq 0 ]
+    [ "$resume_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ]

@@ -1,0 +1,275 @@
+#!/usr/bin/env python
+"""Serving soak: a closed+open-loop load generator that drives the
+ServingEngine under the armed PT_FAULT matrix and asserts the SLOs.
+
+Traffic:
+  * CLOSED loop — ``--clients`` threads, each submits one request with a
+    generous deadline, waits for the terminal reply, repeats.  Models
+    well-behaved callers and guarantees a stream of successes for the
+    latency percentiles.
+  * OPEN loop — the main thread fires ``--requests`` requests at
+    ``--qps`` regardless of replies, each with a ``--deadline-ms``
+    deadline.  Models the traffic that does NOT slow down when the
+    server does — the load that admission control and shedding exist
+    for.
+
+Chaos (armed by the caller via PT_FAULT, see docs/serving.md):
+  ``serve_slow_batch`` latency spikes, ``serve_dispatch`` batch failures
+  (trips the breaker; it must also RECOVER), ``queue_overflow`` forced
+  sheds, ``compile_storm`` cold-compile storms, and ``sigterm`` — the
+  soak delivers a real SIGTERM to itself at open-loop request index
+  ``at`` and the engine must drain: finish in-flight work, refuse new
+  requests, reach STOPPED, with the process alive to report.
+
+Asserted SLOs (--assert-slo), all from ``serving.*`` metrics:
+  * every admitted request got a terminal reply; ``serving.deadlocks``
+    == 0; counters reconcile (admitted == completed + errors +
+    deadline_exceeded + shed)
+  * p99 latency is finite (and there WERE successes)
+  * shed rate <= --shed-ceiling
+  * breaker tripped AND recovered (--expect-breaker)
+  * SIGTERM drain observed: handler ran, engine STOPPED, post-drain
+    submissions refused (--expect-drain)
+
+Prints one JSON line with the verdict and the metrics that prove it.
+"""
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_predictor_backend(tmpdir):
+    """Tiny real model through the full stack: save_inference_model ->
+    Predictor (per-bucket AOT executables, single-flight compiles)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            h = fluid.layers.fc(x, 16, act='relu')
+            probs = fluid.layers.fc(h, 4, act='softmax')
+    exe, scope = fluid.Executor(), fluid.Scope()
+    model_dir = os.path.join(tmpdir, 'model')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ['x'], [probs], exe, main)
+    predictor = fluid.inference.Predictor(model_dir)
+    return predictor.run
+
+
+def build_stub_backend(latency_s):
+    import numpy as np
+
+    def backend(feed):
+        if latency_s:
+            time.sleep(latency_s)
+        x = np.asarray(feed['x'])
+        return [x.sum(axis=tuple(range(1, x.ndim)), keepdims=True)]
+    return backend
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--requests', type=int, default=80,
+                    help='open-loop request count')
+    ap.add_argument('--qps', type=float, default=120.0,
+                    help='open-loop submission rate')
+    ap.add_argument('--clients', type=int, default=3,
+                    help='closed-loop client threads')
+    ap.add_argument('--deadline-ms', type=float, default=2000.0,
+                    help='open-loop per-request deadline')
+    ap.add_argument('--max-queue', type=int, default=32)
+    ap.add_argument('--policy', default='shed_oldest',
+                    choices=('reject', 'block', 'shed_oldest'))
+    ap.add_argument('--shed-ceiling', type=float, default=0.35,
+                    help='max tolerated shed fraction of admitted')
+    ap.add_argument('--stub', action='store_true',
+                    help='stub backend (no compiles) instead of a real '
+                         'Predictor')
+    ap.add_argument('--stub-latency-ms', type=float, default=2.0)
+    ap.add_argument('--assert-slo', action='store_true')
+    ap.add_argument('--expect-breaker', action='store_true',
+                    help='require breaker tripped AND recovered')
+    ap.add_argument('--expect-drain', action='store_true',
+                    help='require a SIGTERM-initiated drain was observed')
+    args = ap.parse_args()
+
+    import numpy as np
+    import paddle_tpu.observability as obs
+    from paddle_tpu import serving
+    from paddle_tpu.data_feeder import FeedBucketer
+    from paddle_tpu.testing import faults as _faults
+
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix='pt_serve_soak.')
+    backend = (build_stub_backend(args.stub_latency_ms / 1e3) if args.stub
+               else build_predictor_backend(tmpdir))
+
+    bucketer = FeedBucketer(boundaries=[1, 2, 4, 8, 16, 32])
+    engine = serving.ServingEngine(
+        backend, bucketer=bucketer,
+        config=serving.ServingConfig(
+            max_queue=args.max_queue, overflow_policy=args.policy,
+            max_batch_rows=32, batch_linger_s=0.002,
+            breaker_failure_threshold=3, breaker_storm_threshold=3,
+            breaker_cooldown_s=0.2, drain_timeout_s=20.0))
+
+    # the soak's own SIGTERM recorder goes in FIRST so the engine's
+    # drain handler (installed second) chains to it — the process stays
+    # alive to finish the drain and report, proving handler composition
+    sigterm_seen = [False]
+    signal.signal(signal.SIGTERM, lambda s, f: sigterm_seen.__setitem__(
+        0, True))
+    engine.install_signal_handlers()
+    engine.start()
+
+    futures = []
+    fut_lock = threading.Lock()
+    stop_clients = threading.Event()
+
+    def feed_at(i):
+        rows = 1 + (i % 3)
+        rng = np.random.RandomState(2000 + i)
+        return {'x': rng.rand(rows, 8).astype('float32')}
+
+    def closed_loop(cid):
+        i = 0
+        while not stop_clients.is_set():
+            fut = engine.submit(feed_at(10000 * (cid + 1) + i),
+                                timeout_s=10.0)
+            with fut_lock:
+                futures.append(fut)
+            try:
+                res = fut.result(timeout=30.0)
+            except TimeoutError:
+                return
+            if res.status == 'rejected' and res.reason in ('draining',
+                                                           'not_ready'):
+                return
+            i += 1
+
+    clients = [threading.Thread(target=closed_loop, args=(c,), daemon=True)
+               for c in range(args.clients)]
+    for t in clients:
+        t.start()
+
+    # open loop: fixed-rate fire-and-remember
+    period = 1.0 / args.qps if args.qps > 0 else 0.0
+    for i in range(args.requests):
+        if _faults.active('sigterm') and _faults.fire('sigterm', step=i):
+            os.kill(os.getpid(), signal.SIGTERM)   # engine drains, we live
+        fut = engine.submit(feed_at(i), timeout_s=args.deadline_ms / 1e3)
+        with fut_lock:
+            futures.append(fut)
+        if period:
+            time.sleep(period)
+        if engine.breaker.state != 'closed':
+            # stretch the tail while tripped so the cooldown elapses
+            # with live traffic still flowing — the recovery probe needs
+            # a real batch to run against
+            time.sleep(0.05)
+
+    drained = engine.drain()
+    stop_clients.set()
+    for t in clients:
+        t.join(timeout=10.0)
+
+    # ---------------------------------------------------------- audit
+    statuses = {}
+    latencies_ok = []
+    no_reply = 0
+    with fut_lock:
+        all_futs = list(futures)
+    for fut in all_futs:
+        if not fut.done():
+            no_reply += 1
+            continue
+        res = fut.result(0)
+        statuses[res.status] = statuses.get(res.status, 0) + 1
+        if res.status == 'ok':
+            latencies_ok.append(res.latency_s * 1e3)
+
+    c = obs.counters()
+
+    def cnt(name):
+        return int(c.get(name) or 0)
+
+    admitted = cnt('serving.admitted')
+    terminal = (cnt('serving.completed') + cnt('serving.errors') +
+                cnt('serving.deadline_exceeded') + cnt('serving.shed'))
+    shed_rate = cnt('serving.shed') / float(max(1, admitted))
+    p50 = float(np.percentile(latencies_ok, 50)) if latencies_ok else None
+    p99 = float(np.percentile(latencies_ok, 99)) if latencies_ok else None
+
+    rec = {
+        'requests_submitted': len(all_futs),
+        'statuses': statuses,
+        'no_reply': no_reply,
+        'admitted': admitted,
+        'terminal_replies': terminal,
+        'shed_rate': round(shed_rate, 4),
+        'p50_ms': p50,
+        'p99_ms': p99,
+        'breaker_trips': cnt('serving.breaker_trips'),
+        'breaker_recoveries': cnt('serving.breaker_recoveries'),
+        'deadlocks': cnt('serving.deadlocks'),
+        'sigterm_seen': sigterm_seen[0],
+        'drained': bool(drained),
+        'state': engine.state,
+        'counters': {k: c.get(k) for k in sorted(c)
+                     if k.startswith('serving.')
+                     or k == 'bucketer.bucket_count'
+                     or k.startswith('faults.')},
+    }
+    print(json.dumps(rec))
+
+    if args.assert_slo:
+        if no_reply:
+            sys.exit('serve_soak: %d request(s) never got a terminal '
+                     'reply' % no_reply)
+        if rec['deadlocks']:
+            sys.exit('serve_soak: serving.deadlocks=%d' % rec['deadlocks'])
+        if terminal != admitted:
+            sys.exit('serve_soak: terminal replies (%d) != admitted (%d) '
+                     '— a request was dropped without a reply'
+                     % (terminal, admitted))
+        if not latencies_ok:
+            sys.exit('serve_soak: zero successful requests — no p99 to '
+                     'measure')
+        if not np.isfinite(p99):
+            sys.exit('serve_soak: p99 is not finite: %r' % p99)
+        if shed_rate > args.shed_ceiling:
+            sys.exit('serve_soak: shed rate %.3f above the ceiling %.3f'
+                     % (shed_rate, args.shed_ceiling))
+        if not rec['state'] == 'stopped':
+            sys.exit('serve_soak: engine did not reach STOPPED '
+                     '(state=%s)' % rec['state'])
+    if args.expect_breaker:
+        if rec['breaker_trips'] < 1 or rec['breaker_recoveries'] < 1:
+            sys.exit('serve_soak: breaker trips=%d recoveries=%d — '
+                     'expected it to trip AND recover'
+                     % (rec['breaker_trips'], rec['breaker_recoveries']))
+    if args.expect_drain:
+        if not sigterm_seen[0]:
+            sys.exit('serve_soak: SIGTERM never chained to the soak '
+                     'recorder — drain handler composition broken')
+        if not rec['drained']:
+            sys.exit('serve_soak: drain did not complete in budget')
+        probe = engine.submit({'x': np.ones((1, 8), 'float32')}).result(1)
+        if probe.status != 'rejected':
+            sys.exit('serve_soak: post-drain submit was not refused '
+                     '(%s)' % probe.status)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
