@@ -114,6 +114,14 @@ def install_watchdog():
             faulthandler.dump_traceback(file=sys.stderr)
         except Exception:
             pass
+        try:
+            # leave a flight-recorder postmortem naming the hung stage
+            from paddle_tpu.observability import flight as _flight
+            _flight.record('bench.watchdog', stage=_STAGE[0],
+                           budget_s=budget)
+            _flight.maybe_dump('watchdog')
+        except Exception:
+            pass
         os._exit(3)
 
     t = threading.Timer(budget, _trip)
@@ -343,9 +351,6 @@ def main():
 
     tps = launches * K * tokens_per_step / dt
 
-    def delta(name):
-        return (snap1.get(name) or 0) - (snap0.get(name) or 0)
-
     # PT_OPT rewriter accounting (core/passes): raw vs optimized traced-op
     # counts for the headline program.  maybe_optimize is memoized per
     # (program version, fetch set), so this reads the stats of the exact
@@ -358,45 +363,19 @@ def main():
     # the backend the bench process ACTUALLY ran on (the probe only says
     # what a subprocess saw) — a CPU fallback can't masquerade as TPU
     dev0 = jax.devices()[0]
-    telemetry = {
-        'platform': dev0.platform,
-        'device_kind': str(dev0.device_kind),
-        'retraces': int(delta('executor.retraces')),
-        'retraces_total': int(snap1.get('executor.retraces') or 0),
-        'compiles': int(snap1.get('executor.compiles') or 0),
-        'compile_s': round(snap1.get('executor.compile_s') or 0.0, 3),
-        # warm-start accounting (core/compile_cache.py): cold = seconds
-        # actually spent tracing+compiling this process; warm = seconds
-        # spent loading AOT executables the persistent cache already had.
-        # A second run over the same PT_CACHE_DIR must show hits > 0 and
-        # compile_s(_cold) collapsing — ci_smoke asserts exactly that.
-        'compile_s_cold': round(snap1.get('executor.compile_s') or 0.0, 3),
-        'compile_s_warm': round(snap1.get('compile_cache.load_s') or 0.0, 3),
-        'compile_cache_hits': int(
-            snap1.get('compile_cache.disk_hits') or 0),
-        'compile_cache_misses': int(
-            snap1.get('compile_cache.disk_misses') or 0),
-        'tail_splits': int(snap1.get('executor.tail_splits') or 0),
-        # trace/compile split: Python tracing (what the PT_OPT rewriter
-        # shrinks) vs the XLA backend compile under it
-        'trace_s': round(snap1.get('executor.trace_s') or 0.0, 3),
-        'backend_compile_s': round(
-            snap1.get('executor.backend_compile_s') or 0.0, 3),
-        # program-rewriter telemetry (PT_OPT=1 default; docs/passes.md)
-        'program_op_count_raw': raw_ops,
-        'program_op_count_opt': opt_ops,
-        'opt_pass_ms': round(snap1.get('opt.pass_ms') or 0.0, 3),
-        'opt_ops_fused': int(snap1.get('opt.ops_fused') or 0),
-        'stall_count': int(delta('executor.stall_count')),
-        'prefetch_starvation_s': round(
-            snap1.get('prefetch.starvation_s') or 0.0, 3),
-        'fetch_sync_s': round(snap1.get('executor.fetch_sync_s') or 0.0, 3),
-        # graceful-degradation accounting (ops/_fallback.py): nonzero
-        # means a pallas kernel silently rerouted to its composed/jnp
-        # path — this number is how BENCH_r04's lost gather round becomes
-        # impossible to miss
-        'kernel_fallbacks': int(snap1.get('kernel.fallbacks') or 0),
-    }
+    # one shared schema (observability/export.py SCHEMA['bench']) builds
+    # the telemetry block — serve_soak/fault_soak read their sections from
+    # the same table, and ci_smoke validates the key set once.  Warm-start
+    # semantics (compile_s_cold = in-process compile seconds, _warm = AOT
+    # cache load seconds, ci_smoke asserts the second run collapses) and
+    # kernel_fallbacks (a pallas kernel degraded to its composed path)
+    # are documented in the schema + docs/observability.md.
+    telemetry = obs.telemetry_snapshot(
+        'bench', baseline=snap0, snapshot=snap1,
+        extra={'platform': dev0.platform,
+               'device_kind': str(dev0.device_kind),
+               'program_op_count_raw': raw_ops,
+               'program_op_count_opt': opt_ops})
     if telemetry['kernel_fallbacks']:
         print('BENCH: WARNING — %d kernel fallback(s): a pallas kernel '
               'degraded to its composed path (run PT_STRICT_KERNELS=1 '

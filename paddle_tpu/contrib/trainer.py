@@ -162,9 +162,14 @@ class Trainer(object):
                         event_handler(BeginStepEvent(epoch_id, step_id + i))
                     fetch = [m.name for m in self.metrics] \
                         if begin.fetch_metrics else []
-                    launch = lambda: self.exe.run_steps(  # noqa: E731
-                        self.train_program, feed_list=buf,
-                        fetch_list=fetch, steps=len(buf))
+                    def launch():
+                        with _obs.trace_context.root_span(
+                                'trainer.step', cat='trainer',
+                                args={'epoch': epoch_id, 'step': step_id,
+                                      'steps': len(buf)}):
+                            return self.exe.run_steps(
+                                self.train_program, feed_list=buf,
+                                fetch_list=fetch, steps=len(buf))
                     stacked = launch() if recovery is None \
                         else recovery.run(launch)
                     if stacked is None:
@@ -217,9 +222,13 @@ class Trainer(object):
                     event_handler(begin)
                     fetch = [m.name for m in self.metrics] \
                         if begin.fetch_metrics else []
-                    launch = lambda: self.exe.run(  # noqa: E731
-                        self.train_program, feed=feeder.feed(data),
-                        fetch_list=fetch)
+                    def launch():
+                        with _obs.trace_context.root_span(
+                                'trainer.step', cat='trainer',
+                                args={'epoch': epoch_id, 'step': step_id}):
+                            return self.exe.run(
+                                self.train_program, feed=feeder.feed(data),
+                                fetch_list=fetch)
                     metrics = launch() if recovery is None \
                         else recovery.run(launch)
                     if metrics is None:

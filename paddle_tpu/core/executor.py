@@ -1156,6 +1156,7 @@ class Executor(object):
             if t_w1 - t_w0 > 1e-4:
                 _obs.tracing.add_span('executor.donate_wait', t_w0, t_w1,
                                       cat='launch')
+            _obs.memory.on_launch()
             _obs.on_launch_end(self, t_w1)
         return fetches
 

@@ -3,38 +3,65 @@
 Always-cheap instrumentation woven through the execution stack (see
 docs/observability.md for the metric catalog and span taxonomy):
 
-  * metrics   — counters/gauges/histograms, thread-safe, snapshot-to-dict,
-                near-zero-overhead no-op mode (PT_OBS=0 or disable())
-  * tracing   — span/event recorder exporting Chrome-trace/Perfetto JSON
-  * retrace   — the retrace explainer: every (re)trace diffs its launch
-                signature against the nearest prior one and names which
-                cache-key component changed
-  * stall     — launch-gap histogram + pipeline-drain detection
+  * metrics       — counters/gauges/histograms (bounded log buckets with
+                    p50/p99), thread-safe, snapshot-to-dict,
+                    near-zero-overhead no-op mode (PT_OBS=0 / disable())
+  * tracing       — span/event recorder exporting Chrome-trace/Perfetto
+                    JSON, with flow-event linking and an ambient
+                    trace-context stamp on every span
+  * trace_context — W3C-traceparent TraceContext minted per serving
+                    request / trainer step, propagated via contextvars
+  * retrace       — the retrace explainer: every (re)trace diffs its
+                    launch signature against the nearest prior one and
+                    names which cache-key component changed
+  * stall         — launch-gap histogram + pipeline-drain detection,
+                    with suppression for intentional slow windows
+                    (breaker slow path, recovery replay)
+  * flight        — black-box flight recorder: bounded ring of recent
+                    events, dumped as a JSON postmortem (PT_FLIGHT_DIR)
+                    on crash/SIGTERM/breaker trip/recovery give-up
+  * export        — Prometheus text rendering, the shared
+                    telemetry_snapshot() JSON schema, and the
+                    /metrics + /healthz + /varz HTTP endpoint
+  * memory        — per-launch device-memory gauges (HBM where the
+                    backend reports it, live-buffer counts everywhere)
 
 Everything is process-global: one training process is one telemetry
 stream.  `snapshot()` returns the whole state as one dict; `reset()`
 clears it (profiler.reset_profiler routes here).
 """
 from . import metrics  # noqa
+from . import trace_context  # noqa
 from . import tracing  # noqa
 from . import retrace  # noqa
 from . import stall  # noqa
+from . import flight  # noqa
+from . import export  # noqa
+from . import memory  # noqa
 
 from .metrics import (enabled, enable, disable, counter, gauge,  # noqa
                       histogram, metrics_snapshot, counters, registry)
-from .tracing import (span, instant, add_span, export_chrome_trace,  # noqa
-                      span_summary, recorder)
+from .tracing import (span, instant, add_span, add_flow,  # noqa
+                      export_chrome_trace, span_summary, recorder)
+from .trace_context import TraceContext  # noqa
 from .retrace import LaunchSignature, explainer  # noqa
 from .stall import (on_launch_start, on_launch_end,  # noqa
                     stall_threshold_ms, set_stall_threshold_ms)
+from .export import render_prometheus, telemetry_snapshot  # noqa
 
-__all__ = ['metrics', 'tracing', 'retrace', 'stall', 'enabled', 'enable',
-           'disable', 'counter', 'gauge', 'histogram', 'metrics_snapshot',
-           'counters', 'registry', 'span', 'instant', 'add_span',
+__all__ = ['metrics', 'tracing', 'trace_context', 'retrace', 'stall',
+           'flight', 'export', 'memory', 'enabled', 'enable', 'disable',
+           'counter', 'gauge', 'histogram', 'metrics_snapshot', 'counters',
+           'registry', 'span', 'instant', 'add_span', 'add_flow',
            'export_chrome_trace', 'span_summary', 'recorder',
-           'LaunchSignature', 'explainer', 'on_launch_start',
-           'on_launch_end', 'stall_threshold_ms', 'set_stall_threshold_ms',
-           'snapshot', 'reset']
+           'TraceContext', 'LaunchSignature', 'explainer',
+           'on_launch_start', 'on_launch_end', 'stall_threshold_ms',
+           'set_stall_threshold_ms', 'render_prometheus',
+           'telemetry_snapshot', 'snapshot', 'reset']
+
+# every trace event mirrors into the flight ring (bounded; lock-free
+# appends), so a postmortem dump always carries the recent timeline
+flight.install_tap()
 
 
 def snapshot():
@@ -50,3 +77,4 @@ def reset():
     metrics.reset()
     tracing.reset()
     retrace.reset()
+    flight.flight().reset()

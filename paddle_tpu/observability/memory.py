@@ -1,0 +1,100 @@
+"""Device-memory profiling hooks.
+
+HBM is the binding constraint for the fusion work in ROADMAP item 2,
+and until now the runtime never measured it.  `on_launch()` is called
+by the executor after every device launch (only when telemetry is on)
+and samples:
+
+  * `exec.hbm_peak_bytes` / `exec.hbm_in_use_bytes` / `exec.hbm_limit_bytes`
+    — from `device.memory_stats()` where the backend supports it (TPU,
+    GPU).  CPU's `memory_stats()` returns None; the probe caches that
+    verdict once and the hook degrades to a single cached-flag check —
+    the graceful no-op the CPU CI path runs.
+  * `exec.live_buffers` — `len(jax.live_arrays())`, which works on
+    every backend and catches buffer leaks (a serving soak whose live
+    count climbs monotonically is holding results somewhere).
+
+`host_rss_bytes()` reports the process high-water RSS (checkpoint
+snapshots are forced host copies; train/checkpoint.py accounts their
+bytes in `ckpt.snapshot_host_bytes`).  `PT_OBS_MEM=0` switches the
+whole module off independently of PT_OBS.
+"""
+import os
+
+from . import metrics
+
+__all__ = ['on_launch', 'device_memory_stats', 'live_buffer_count',
+           'host_rss_bytes', 'mem_enabled']
+
+_MEM_ON = [os.environ.get('PT_OBS_MEM', '1') not in ('0', 'false', 'False')]
+# tri-state cache: None = not probed, False = backend has no stats,
+# True = stats available
+_STATS_SUPPORTED = [None]
+
+
+def mem_enabled():
+    return _MEM_ON[0] and metrics.enabled()
+
+
+def _reset_probe():
+    _STATS_SUPPORTED[0] = None
+
+
+def device_memory_stats():
+    """The first local device's memory stats dict, or None when the
+    backend doesn't report them (CPU).  The negative verdict is cached —
+    per-launch cost on CPU is one list lookup."""
+    if _STATS_SUPPORTED[0] is False:
+        return None
+    try:
+        import jax
+        devs = jax.local_devices()
+        stats = devs[0].memory_stats() if devs else None
+    except Exception:
+        stats = None
+    if not stats:
+        _STATS_SUPPORTED[0] = False
+        return None
+    _STATS_SUPPORTED[0] = True
+    return stats
+
+
+def live_buffer_count():
+    try:
+        import jax
+        return len(jax.live_arrays())
+    except Exception:
+        return None
+
+
+def on_launch():
+    """Per-launch sampling hook (executor calls this with obs enabled)."""
+    if not _MEM_ON[0] or not metrics.enabled():
+        return
+    stats = device_memory_stats()
+    if stats:
+        peak = stats.get('peak_bytes_in_use')
+        in_use = stats.get('bytes_in_use')
+        limit = stats.get('bytes_limit')
+        if peak is not None:
+            metrics.gauge('exec.hbm_peak_bytes').set(int(peak))
+        if in_use is not None:
+            metrics.gauge('exec.hbm_in_use_bytes').set(int(in_use))
+        if limit is not None:
+            metrics.gauge('exec.hbm_limit_bytes').set(int(limit))
+    live = live_buffer_count()
+    if live is not None:
+        metrics.gauge('exec.live_buffers').set(live)
+
+
+def host_rss_bytes():
+    """Process peak RSS in bytes (ru_maxrss is KiB on Linux)."""
+    try:
+        import resource
+        rss_kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        import sys
+        if sys.platform == 'darwin':   # macOS reports bytes already
+            return int(rss_kib)
+        return int(rss_kib) * 1024
+    except Exception:
+        return None

@@ -8,9 +8,14 @@ call when off, so disabled mode costs a single branch — individual
 metric mutators also check the flag as a second line of defense for
 call sites that don't batch their guard.
 
-Histogram buckets are power-of-two (frexp exponent): cheap to compute,
-wide dynamic range, good enough to tell a 2 ms launch gap from a 200 ms
-pipeline drain.
+Histogram buckets are log-spaced (frexp exponent refined by a fixed
+linear subdivision of the mantissa): cheap to compute, wide dynamic
+range, and *bounded* — the backing store is a dict keyed by bucket
+index, so a week-long soak recording millions of observations holds a
+few dozen buckets, never a sample list.  Quantiles (`quantile(q)`,
+p50/p99 in `snapshot()`) interpolate within the target bucket; with 4
+sub-buckets per octave the worst-case relative error is ~12%, plenty to
+steer an SLO gate.
 """
 import math
 import os
@@ -75,10 +80,33 @@ class Gauge(object):
         return self.value
 
 
+_SUBBUCKETS = 4  # linear mantissa subdivisions per power-of-two octave
+
+
+def _bucket_index(value):
+    """Bucket index for a positive value: frexp exponent refined by a
+    linear split of the mantissa into _SUBBUCKETS ranges."""
+    m, e = math.frexp(value)          # value = m * 2^e, m in [0.5, 1)
+    sub = int((m * 2.0 - 1.0) * _SUBBUCKETS)
+    if sub >= _SUBBUCKETS:
+        sub = _SUBBUCKETS - 1
+    return e * _SUBBUCKETS + sub
+
+
+def _bucket_bounds(idx):
+    """(low, high] value range covered by bucket `idx`."""
+    e, sub = divmod(idx, _SUBBUCKETS)
+    lo = math.ldexp(1.0 + sub / float(_SUBBUCKETS), e - 1)
+    hi = math.ldexp(1.0 + (sub + 1) / float(_SUBBUCKETS), e - 1)
+    return lo, hi
+
+
 class Histogram(object):
-    """count/sum/min/max plus power-of-two buckets keyed by the frexp
-    exponent e (bucket e holds values in (2^(e-1), 2^e])."""
-    __slots__ = ('name', 'count', 'total', 'min', 'max', 'buckets', '_lock')
+    """count/sum/min/max plus bounded log-spaced buckets (see module
+    docstring).  Non-positive observations land in a dedicated slot so
+    they can't alias a real bucket."""
+    __slots__ = ('name', 'count', 'total', 'min', 'max', 'buckets',
+                 'nonpos', '_lock')
 
     def __init__(self, name):
         self.name = name
@@ -87,29 +115,80 @@ class Histogram(object):
         self.min = None
         self.max = None
         self.buckets = {}
+        self.nonpos = 0
         self._lock = threading.Lock()
 
     def observe(self, value):
         if not _ENABLED[0]:
             return
         value = float(value)
-        e = math.frexp(value)[1] if value > 0.0 else 0
+        idx = _bucket_index(value) if value > 0.0 else None
         with self._lock:
             self.count += 1
             self.total += value
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
-            self.buckets[e] = self.buckets.get(e, 0) + 1
+            if idx is None:
+                self.nonpos += 1
+            else:
+                self.buckets[idx] = self.buckets.get(idx, 0) + 1
+
+    def _quantile_locked(self, q):
+        if not self.count:
+            return None
+        target = q * self.count
+        run = float(self.nonpos)
+        if self.nonpos and run >= target:
+            return min(self.min, 0.0)
+        for idx in sorted(self.buckets):
+            n = self.buckets[idx]
+            if run + n >= target:
+                lo, hi = _bucket_bounds(idx)
+                lo = max(lo, self.min)
+                hi = min(hi, self.max)
+                frac = (target - run) / n
+                return lo + (hi - lo) * frac
+            run += n
+        return self.max
+
+    def quantile(self, q):
+        """Interpolated quantile estimate in [min, max]; None when empty."""
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def bucket_count(self):
+        with self._lock:
+            return len(self.buckets) + (1 if self.nonpos else 0)
 
     def snapshot(self):
         with self._lock:
             if not self.count:
                 return {'count': 0}
-            return {'count': self.count, 'sum': self.total,
-                    'min': self.min, 'max': self.max,
-                    'mean': self.total / self.count,
-                    'buckets': {'le_2^%d' % e: n
-                                for e, n in sorted(self.buckets.items())}}
+            out = {'count': self.count, 'sum': self.total,
+                   'min': self.min, 'max': self.max,
+                   'mean': self.total / self.count,
+                   'p50': self._quantile_locked(0.50),
+                   'p99': self._quantile_locked(0.99),
+                   'buckets': {'le_%g' % _bucket_bounds(idx)[1]: n
+                               for idx, n in sorted(self.buckets.items())}}
+            if self.nonpos:
+                out['buckets']['le_0'] = self.nonpos
+            return out
+
+    def cumulative_buckets(self):
+        """[(upper_bound, cumulative_count)] ascending — the Prometheus
+        `le` rendering shape (observability/export.py)."""
+        with self._lock:
+            items = sorted(self.buckets.items())
+            nonpos = self.nonpos
+        out = []
+        run = nonpos
+        if nonpos:
+            out.append((0.0, run))
+        for idx, n in items:
+            run += n
+            out.append((_bucket_bounds(idx)[1], run))
+        return out
 
 
 class MetricsRegistry(object):
@@ -150,6 +229,12 @@ class MetricsRegistry(object):
                     'gauges' if isinstance(m, Gauge) else 'histograms')
             out[kind][name] = m.snapshot()
         return out
+
+    def items(self):
+        """Sorted [(name, metric_object)] — the export renderer walks
+        live objects (cumulative buckets need more than snapshot())."""
+        with self._lock:
+            return sorted(self._metrics.items())
 
     def counters(self):
         """Flat {name: value} over counters AND gauges (the shape bench.py

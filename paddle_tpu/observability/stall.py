@@ -9,16 +9,65 @@ gap lands in the `executor.launch_gap_ms` histogram and, above the
 threshold (PT_OBS_STALL_MS, default 100 ms), increments
 `executor.stall_count` and drops a `pipeline.stall` instant event on the
 timeline so the drain is a recorded fact with a timestamp.
+
+Gaps are only meaningful when the runtime is *trying* to go fast.
+During a breaker slow-path dispatch (one request at a time, on purpose)
+or a recovery rollback/replay window, host-side gaps are the degraded
+mode working as designed — `suppress(reason)` marks those windows so
+they count `executor.stall_suppressed` instead of polluting the stall
+SLO, and `clear_window(executor)` forgets the previous launch-end mark
+entirely after a rollback (the replay's first launch has no meaningful
+predecessor).
 """
+import contextlib
 import os
+import threading
 
 from . import metrics
 from . import tracing
 
 __all__ = ['on_launch_start', 'on_launch_end', 'stall_threshold_ms',
-           'set_stall_threshold_ms']
+           'set_stall_threshold_ms', 'suppress', 'suppressed',
+           'clear_window']
 
 _STALL_MS = [float(os.environ.get('PT_OBS_STALL_MS', '100'))]
+
+# Suppression is a process-global depth counter: the serving dispatch
+# thread enters it around degraded-mode dispatches, and the launch-gap
+# check (which runs on the same thread, inside the backend call) reads
+# it.  A lock keeps enter/exit races from under/overflowing the depth.
+_SUPPRESS_LOCK = threading.Lock()
+_SUPPRESS = [0]
+_SUPPRESS_REASON = [None]
+
+
+@contextlib.contextmanager
+def suppress(reason):
+    """Mark the with-block as an intentional slow window: launch gaps
+    inside it never count as pipeline stalls."""
+    with _SUPPRESS_LOCK:
+        _SUPPRESS[0] += 1
+        _SUPPRESS_REASON[0] = reason
+    try:
+        yield
+    finally:
+        with _SUPPRESS_LOCK:
+            _SUPPRESS[0] -= 1
+            if _SUPPRESS[0] == 0:
+                _SUPPRESS_REASON[0] = None
+
+
+def suppressed():
+    return _SUPPRESS[0] > 0
+
+
+def clear_window(owner):
+    """Forget `owner`'s previous launch-end mark (recovery rollback: the
+    replayed first launch must not be measured against the pre-rollback
+    timeline)."""
+    if getattr(owner, '_obs_prev_launch_end', None) is not None:
+        owner._obs_prev_launch_end = None
+        metrics.counter('executor.stall_windows_cleared').inc()
 
 
 def stall_threshold_ms():
@@ -38,6 +87,12 @@ def on_launch_start(owner, t_start):
     gap_ms = (t_start - prev_end) * 1000.0
     metrics.histogram('executor.launch_gap_ms').observe(gap_ms)
     if gap_ms > _STALL_MS[0]:
+        if _SUPPRESS[0]:
+            metrics.counter('executor.stall_suppressed').inc()
+            tracing.instant('pipeline.stall_suppressed', cat='stall',
+                            args={'gap_ms': round(gap_ms, 3),
+                                  'reason': _SUPPRESS_REASON[0]})
+            return
         metrics.counter('executor.stall_count').inc()
         metrics.counter('executor.stall_s').inc(gap_ms / 1000.0)
         tracing.instant('pipeline.stall', cat='stall',

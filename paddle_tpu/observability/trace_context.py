@@ -1,0 +1,106 @@
+"""Request-level trace context: W3C-traceparent-compatible identifiers
+plus an ambient (contextvars) current-context slot.
+
+A `TraceContext` is minted once per logical request (`ServingEngine.
+submit`) or per trainer step, carried on the request object through
+admission -> queue -> dispatch coalescing -> executor launch -> reply,
+and stamped into every span recorded on its behalf (`span_args()`).
+Spans recorded by layers that never see the request object (the
+executor hot path, the compile pipeline) still join the trace through
+the ambient context: `use(ctx)` installs it for the dynamic extent of a
+dispatch and `tracing.add_complete` attaches the ids automatically.
+
+Wire format is the W3C trace-context `traceparent` header
+(`00-<32 hex trace-id>-<16 hex span-id>-<2 hex flags>`), so an edge
+proxy's header can be threaded straight through `from_traceparent`.
+"""
+import contextlib
+import contextvars
+import os
+import re
+
+__all__ = ['TraceContext', 'current', 'use', 'root_span']
+
+_TRACEPARENT_RE = re.compile(
+    r'^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$')
+
+_CURRENT = contextvars.ContextVar('pt_trace_context', default=None)
+
+
+class TraceContext(object):
+    __slots__ = ('trace_id', 'span_id', 'parent_span_id')
+
+    def __init__(self, trace_id, span_id, parent_span_id=None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_span_id = parent_span_id
+
+    @classmethod
+    def new(cls):
+        return cls(os.urandom(16).hex(), os.urandom(8).hex())
+
+    def child(self):
+        """Fresh span id under the same trace, parented to this span."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(), self.span_id)
+
+    def to_traceparent(self):
+        return '00-%s-%s-01' % (self.trace_id, self.span_id)
+
+    @classmethod
+    def from_traceparent(cls, header):
+        """Parse a W3C traceparent header; returns None on malformed
+        input (callers fall back to minting a fresh context)."""
+        if not header:
+            return None
+        m = _TRACEPARENT_RE.match(header.strip().lower())
+        if m is None or m.group(2) == '0' * 32 or m.group(3) == '0' * 16:
+            return None
+        return cls(m.group(2), m.group(3))
+
+    def span_args(self, **extra):
+        """Dict to merge into a span's `args`."""
+        d = {'trace_id': self.trace_id, 'span_id': self.span_id}
+        if self.parent_span_id:
+            d['parent_span_id'] = self.parent_span_id
+        if extra:
+            d.update(extra)
+        return d
+
+    def __repr__(self):
+        return 'TraceContext(%s)' % self.to_traceparent()
+
+
+def current():
+    """The ambient TraceContext for this thread/task, or None."""
+    return _CURRENT.get()
+
+
+@contextlib.contextmanager
+def use(ctx):
+    """Install `ctx` as the ambient context for the with-block."""
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+
+
+@contextlib.contextmanager
+def root_span(name, cat='trace', args=None):
+    """Mint a fresh trace, install it, and record `name` as its root
+    span around the with-block.  No-op (no ids, no span) when telemetry
+    is disabled."""
+    from . import metrics, tracing
+    import time
+    if not metrics.enabled():
+        yield None
+        return
+    ctx = TraceContext.new()
+    t0 = time.perf_counter()
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _CURRENT.reset(token)
+        tracing.recorder().add_complete(
+            name, t0, time.perf_counter(), cat, ctx.span_args(**(args or {})))

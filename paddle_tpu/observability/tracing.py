@@ -19,9 +19,40 @@ import time
 from collections import deque
 
 from .metrics import enabled
+from . import trace_context as _tc
 
 __all__ = ['TraceRecorder', 'recorder', 'span', 'instant', 'add_span',
-           'export_chrome_trace', 'span_summary', 'reset']
+           'add_flow', 'export_chrome_trace', 'span_summary', 'reset',
+           'set_tap']
+
+# Optional event tap (the flight recorder's feed).  One slot, called
+# outside the recorder lock with the already-built event dict.
+_TAP = [None]
+
+
+def set_tap(fn):
+    """Install `fn(event_dict)` to observe every recorded event; pass
+    None to remove.  Returns the previous tap."""
+    prev = _TAP[0]
+    _TAP[0] = fn
+    return prev
+
+
+def _attach_ctx(args):
+    """Merge the ambient TraceContext (if any) into span args so spans
+    recorded deep in the stack (executor, compile pipeline) join the
+    request trace that dispatched them."""
+    ctx = _tc.current()
+    if ctx is None:
+        return args
+    if args is None:
+        return {'trace_id': ctx.trace_id, 'parent_span_id': ctx.span_id}
+    if 'trace_id' in args:
+        return args
+    args = dict(args)
+    args['trace_id'] = ctx.trace_id
+    args['parent_span_id'] = ctx.span_id
+    return args
 
 _EPOCH = time.perf_counter()
 _PID = os.getpid()
@@ -42,6 +73,7 @@ class TraceRecorder(object):
     def add_complete(self, name, start_pc, end_pc, cat='runtime', args=None):
         """One 'X' (complete) event spanning [start_pc, end_pc] — raw
         time.perf_counter() values."""
+        args = _attach_ctx(args)
         ev = {'name': name, 'ph': 'X', 'cat': cat,
               'ts': _us(start_pc), 'dur': max(0.0, (end_pc - start_pc) * 1e6),
               'pid': _PID, 'tid': threading.get_ident()}
@@ -51,13 +83,33 @@ class TraceRecorder(object):
             if len(self._events) == self._events.maxlen:
                 self._dropped += 1
             self._events.append(ev)
+        tap = _TAP[0]
+        if tap is not None:
+            tap(ev)
 
     def add_instant(self, name, cat='runtime', args=None):
+        args = _attach_ctx(args)
         ev = {'name': name, 'ph': 'i', 's': 't', 'cat': cat,
               'ts': _us(time.perf_counter()),
               'pid': _PID, 'tid': threading.get_ident()}
         if args:
             ev['args'] = args
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+        tap = _TAP[0]
+        if tap is not None:
+            tap(ev)
+
+    def add_flow(self, flow_id, phase, ts_pc, name='link', cat='flow'):
+        """Flow ('s' start / 'f' finish) event — the Perfetto arrow
+        linking a request's submit-side slice to its batch slice."""
+        ev = {'name': name, 'ph': 's' if phase == 's' else 'f',
+              'id': flow_id, 'cat': cat, 'ts': _us(ts_pc),
+              'pid': _PID, 'tid': threading.get_ident()}
+        if ev['ph'] == 'f':
+            ev['bp'] = 'e'
         with self._lock:
             if len(self._events) == self._events.maxlen:
                 self._dropped += 1
@@ -138,6 +190,11 @@ def add_span(name, start_pc, end_pc, cat='runtime', args=None):
 def instant(name, cat='runtime', args=None):
     if enabled():
         _RECORDER.add_instant(name, cat, args)
+
+
+def add_flow(flow_id, phase, ts_pc, name='link', cat='flow'):
+    if enabled():
+        _RECORDER.add_flow(flow_id, phase, ts_pc, name, cat)
 
 
 def export_chrome_trace(path):

@@ -29,6 +29,7 @@ import threading
 import time
 
 from .. import observability as _obs
+from ..observability import flight as _flight
 
 __all__ = ['CircuitBreaker', 'CLOSED', 'OPEN', 'HALF_OPEN']
 
@@ -61,7 +62,9 @@ class CircuitBreaker(object):
         _obs.metrics.gauge('serving.breaker_state').set(_STATE_GAUGE[state])
 
     def _trip(self, reason):
+        tripped = False
         if self._state != OPEN:
+            tripped = True
             self.trips += 1
             _obs.metrics.counter('serving.breaker_trips').inc()
             _obs.tracing.instant('serving.breaker_trip', cat='serving',
@@ -70,25 +73,35 @@ class CircuitBreaker(object):
         self._opened_at = self._clock()
         self._consec_failures = 0
         self._consec_cold = 0
+        return tripped
 
     def record_failure(self):
         """A dispatched batch raised."""
         with self._lock:
             if self._state == HALF_OPEN:
-                self._trip('probe_failed')
-                return
-            self._consec_failures += 1
-            if self._consec_failures >= self.failure_threshold:
-                self._trip('consecutive_failures')
+                tripped, reason = self._trip('probe_failed'), 'probe_failed'
+            else:
+                self._consec_failures += 1
+                tripped, reason = False, 'consecutive_failures'
+                if self._consec_failures >= self.failure_threshold:
+                    tripped = self._trip(reason)
+        if tripped:
+            # a trip is a postmortem-worthy transition; dump outside the
+            # lock so the artifact write never blocks state reads
+            _flight.maybe_dump('breaker_trip', extra={'reason': reason})
 
     def record_cold(self):
         """A dispatched batch needed a cold compile."""
+        tripped = False
         with self._lock:
             if self._state == OPEN:
                 return
             self._consec_cold += 1
             if self._consec_cold >= self.storm_threshold:
-                self._trip('compile_storm')
+                tripped = self._trip('compile_storm')
+        if tripped:
+            _flight.maybe_dump('breaker_trip',
+                               extra={'reason': 'compile_storm'})
 
     def record_success(self, cold=False):
         """A dispatched batch completed (``cold``: it also compiled —

@@ -35,6 +35,7 @@ deterministically, and ``tools/serve_soak.py`` asserts the SLOs while
 they fire.
 """
 import collections
+import contextlib
 import signal as _sigmod
 import threading
 import time
@@ -42,6 +43,8 @@ import time
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import flight as _flight
+from ..observability import trace_context as _tc
 from ..core import signals as _signals
 from ..testing import faults as _faults
 from .admission import OVERFLOW_POLICIES, TokenBucket
@@ -70,7 +73,8 @@ class ServingConfig(object):
                  batch_linger_s=0.0, default_timeout_s=None,
                  rate_qps=None, rate_burst=None,
                  breaker_failure_threshold=3, breaker_storm_threshold=3,
-                 breaker_cooldown_s=0.25, drain_timeout_s=10.0):
+                 breaker_cooldown_s=0.25, drain_timeout_s=10.0,
+                 metrics_port=None):
         if overflow_policy not in OVERFLOW_POLICIES:
             raise ValueError('overflow_policy must be one of %s, got %r'
                              % (OVERFLOW_POLICIES, overflow_policy))
@@ -90,6 +94,9 @@ class ServingConfig(object):
         self.breaker_storm_threshold = int(breaker_storm_threshold)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
         self.drain_timeout_s = float(drain_timeout_s)
+        # /metrics endpoint port: explicit int beats PT_METRICS_PORT
+        # (0 = ephemeral, for tests); None + no env = no server
+        self.metrics_port = metrics_port
 
 
 class ServeResult(object):
@@ -122,13 +129,16 @@ class ServeResult(object):
 
 
 class ServeFuture(object):
-    """Client handle: blocks in ``result()`` until the terminal reply."""
-    __slots__ = ('_event', '_result', '_lock')
+    """Client handle: blocks in ``result()`` until the terminal reply.
+    ``traceparent`` is the request's W3C trace header (None with
+    PT_OBS=0) — the id to look up in a Perfetto export."""
+    __slots__ = ('_event', '_result', '_lock', 'traceparent')
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._lock = threading.Lock()
+        self.traceparent = None
 
     def _resolve(self, result):
         with self._lock:
@@ -154,15 +164,22 @@ class ServeFuture(object):
 
 class _Request(object):
     __slots__ = ('feed', 'rows', 'signature', 'deadline', 'future',
-                 't_submit')
+                 't_submit', 'trace', 't_pc')
 
-    def __init__(self, feed, rows, signature, deadline, t_submit):
+    def __init__(self, feed, rows, signature, deadline, t_submit,
+                 trace=None, t_pc=None):
         self.feed = feed
         self.rows = rows
         self.signature = signature
         self.deadline = deadline
         self.future = ServeFuture()
         self.t_submit = t_submit
+        # tracing: the request's root TraceContext and the perf_counter
+        # submit mark its spans measure from (both None with PT_OBS=0)
+        self.trace = trace
+        self.t_pc = t_pc
+        if trace is not None:
+            self.future.traceparent = trace.to_traceparent()
 
 
 class ServingEngine(object):
@@ -201,6 +218,7 @@ class ServingEngine(object):
         if bucketer is not None:
             self._row_limit = min(self._row_limit,
                                   int(bucketer.boundaries[-1]))
+        self._http = None
         _obs.metrics.gauge('serving.state').set(_STATE_GAUGE[STARTING])
 
     @classmethod
@@ -249,7 +267,29 @@ class ServingEngine(object):
                                             daemon=True)
             self._set_state(READY)
             self._thread.start()
+        self._start_metrics_server()
         return self
+
+    def _start_metrics_server(self):
+        """/metrics + /healthz + /varz, engine-owned: up at start(),
+        down at stop().  Enabled by ServingConfig.metrics_port or
+        PT_METRICS_PORT; inert under PT_OBS=0."""
+        if self._http is not None or not _obs.enabled():
+            return
+        port = _obs.export.resolve_metrics_port(self._cfg.metrics_port)
+        if port is None:
+            return
+        self._http = _obs.export.start_http_server(port, engine=self)
+
+    @property
+    def metrics_port(self):
+        """Bound /metrics port, or None when no server is running."""
+        return self._http.port if self._http is not None else None
+
+    def stop_metrics_server(self):
+        http, self._http = self._http, None
+        if http is not None:
+            http.stop()
 
     def begin_drain(self):
         """Refuse new requests, keep dispatching until the queue is
@@ -288,6 +328,7 @@ class ServingEngine(object):
             self.wait_drained(5.0)
         if self._thread is not None:
             self._thread.join(timeout=1.0)
+        self.stop_metrics_server()
         return self._stopped.is_set()
 
     def __enter__(self):
@@ -309,7 +350,9 @@ class ServingEngine(object):
         def make(signum, prev):
             def _handler(s, frame):
                 _obs.metrics.counter('serving.signal_drains').inc()
+                _flight.record('serving.signal_drain', signum=int(s))
                 self.begin_drain()
+                _flight.maybe_dump('sigterm')
                 _signals.chain_previous(prev, s, frame, redeliver=False)
             return _handler
 
@@ -326,33 +369,39 @@ class ServingEngine(object):
         as an already-terminal ``rejected`` result with a named reason,
         never an exception and never silence."""
         t_submit = self._clock()
+        obs_on = _obs.enabled()
+        trace = _tc.TraceContext.new() if obs_on else None
+        t_pc = time.perf_counter() if obs_on else None
         _obs.metrics.counter('serving.submitted').inc()
         try:
             arrays = {k: np.asarray(v) for k, v in dict(feed).items()}
         except Exception as e:
             return self._rejected(t_submit, 'bad_request',
-                                  'unfeedable request: %r' % (e,))
+                                  'unfeedable request: %r' % (e,),
+                                  trace, t_pc)
         if not arrays:
             return self._rejected(t_submit, 'bad_request',
-                                  'empty feed dict')
+                                  'empty feed dict', trace, t_pc)
         dims = {a.shape[0] for a in arrays.values() if a.ndim >= 1}
         if len(dims) != 1 or any(a.ndim == 0 for a in arrays.values()):
             return self._rejected(
                 t_submit, 'bad_request',
                 'request feeds need one shared leading batch dim; got '
-                'shapes %s' % {k: a.shape for k, a in arrays.items()})
+                'shapes %s' % {k: a.shape for k, a in arrays.items()},
+                trace, t_pc)
         rows = dims.pop()
         if rows == 0:
             return self._rejected(
                 t_submit, 'empty_batch',
                 'batch=0 request rejected: a serving request must carry '
-                'at least one row (got leading dim 0)')
+                'at least one row (got leading dim 0)', trace, t_pc)
         if rows > self._row_limit:
             return self._rejected(
                 t_submit, 'too_large',
                 'request batch %d exceeds the serving limit %d (largest '
                 'bucket boundary / max_batch_rows); split the request — '
-                'nothing is silently truncated' % (rows, self._row_limit))
+                'nothing is silently truncated' % (rows, self._row_limit),
+                trace, t_pc)
         if timeout_s is None:
             timeout_s = self._cfg.default_timeout_s
         deadline = None
@@ -361,16 +410,28 @@ class ServingEngine(object):
                 return self._rejected(
                     t_submit, 'deadline',
                     'deadline already expired at admission '
-                    '(timeout_s=%r)' % timeout_s)
+                    '(timeout_s=%r)' % timeout_s, trace, t_pc)
             deadline = t_submit + float(timeout_s)
         if self._rate is not None and not self._rate.try_acquire():
             return self._rejected(t_submit, 'rate',
                                   'token-bucket rate limit exceeded '
-                                  '(rate_qps=%r)' % self._cfg.rate_qps)
+                                  '(rate_qps=%r)' % self._cfg.rate_qps,
+                                  trace, t_pc)
         signature = tuple(sorted((k, str(a.dtype), a.shape[1:])
                                  for k, a in arrays.items()))
-        req = _Request(arrays, int(rows), signature, deadline, t_submit)
-        return self._admit(req, t_submit)
+        req = _Request(arrays, int(rows), signature, deadline, t_submit,
+                       trace=trace, t_pc=t_pc)
+        fut = self._admit(req, t_submit)
+        if trace is not None:
+            # the caller-thread slice the Perfetto flow arrow starts
+            # from; the matching 'f' binds to the batch slice
+            t_now = time.perf_counter()
+            _obs.tracing.recorder().add_complete(
+                'serving.submit', t_pc, t_now, cat='serving',
+                args=trace.span_args(rows=int(rows)))
+            _obs.tracing.add_flow(trace.trace_id[:16], 's', t_pc,
+                                  name='serving.link', cat='serving')
+        return fut
 
     def _admit(self, req, t_submit):
         cfg = self._cfg
@@ -419,12 +480,30 @@ class ServingEngine(object):
                                 'oldest queued one (shed_oldest policy)')
         return req.future
 
-    def _rejected(self, t_submit, reason, message):
+    def _emit_root_span(self, trace, t_pc, status, reason=None, rows=None):
+        """The request's single root span, `serving.request` — emitted
+        exactly once, at terminal resolution, so its status IS the
+        terminal reply's status."""
+        if trace is None or t_pc is None:
+            return
+        args = trace.span_args(status=status)
+        if reason:
+            args['reason'] = reason
+        if rows is not None:
+            args['rows'] = int(rows)
+        _obs.tracing.recorder().add_complete(
+            'serving.request', t_pc, time.perf_counter(), cat='serving',
+            args=args)
+
+    def _rejected(self, t_submit, reason, message, trace=None, t_pc=None):
         fut = ServeFuture()
+        if trace is not None:
+            fut.traceparent = trace.to_traceparent()
         fut._resolve(ServeResult(REJECTED, error=message, reason=reason,
                                  latency_s=self._clock() - t_submit))
         _obs.metrics.counter('serving.rejected').inc()
         _obs.metrics.counter('serving.rejected.%s' % reason).inc()
+        self._emit_root_span(trace, t_pc, REJECTED, reason=reason)
         return fut
 
     def _rejected_locked(self, req, reason, message):
@@ -435,6 +514,8 @@ class ServingEngine(object):
                                  latency_s=self._clock() - req.t_submit))
         _obs.metrics.counter('serving.rejected').inc()
         _obs.metrics.counter('serving.rejected.%s' % reason).inc()
+        self._emit_root_span(req.trace, req.t_pc, REJECTED, reason=reason,
+                             rows=req.rows)
         return fut
 
     def infer(self, feed, timeout_s=None, wait_timeout=None):
@@ -511,12 +592,39 @@ class ServingEngine(object):
                    for k in ('executor.compiles', 'executor.retraces',
                              'compile_cache.disk_misses'))
 
+    def _emit_batch_span(self, batch, batch_ctx, t0, t_end, mode,
+                         total_rows, pad_rows, cold, status):
+        """The `serving.batch` span: one per dispatch, *linking* every
+        coalesced request's trace (args.links + a flow 'f' per request),
+        so a Perfetto export walks request root -> batch -> executor."""
+        rec = _obs.tracing.recorder()
+        args = batch_ctx.span_args(
+            links=[r.trace.trace_id for r in batch if r.trace is not None],
+            requests=len(batch), rows=int(total_rows),
+            pad_rows=int(pad_rows), mode=mode or 'normal',
+            cold=bool(cold), status=status)
+        rec.add_complete('serving.batch', t0, t_end, cat='serving',
+                         args=args)
+        for r in batch:
+            if r.trace is not None:
+                rec.add_flow(r.trace.trace_id[:16], 'f', t0,
+                             name='serving.link', cat='serving')
+
     def _run_batch(self, batch, mode):
         t0 = time.perf_counter()
         now = self._clock()
+        obs_on = _obs.enabled()
+        batch_ctx = _tc.TraceContext.new() if obs_on else None
         for r in batch:
             _obs.metrics.histogram('serving.queue_wait_ms').observe(
                 max(0.0, (now - r.t_submit) * 1e3))
+            if batch_ctx is not None and r.trace is not None:
+                # queue-wait child: submit -> dispatch pick
+                _obs.tracing.recorder().add_complete(
+                    'serving.queue_wait', r.t_pc, t0, cat='serving',
+                    args={'trace_id': r.trace.trace_id,
+                          'parent_span_id': r.trace.span_id,
+                          'batch_span_id': batch_ctx.span_id})
         total_rows = sum(r.rows for r in batch)
         cold = False
         if _faults.any_active():
@@ -531,16 +639,52 @@ class ServingEngine(object):
                     for k in batch[0].feed}
         if self._bucketer is not None:
             feed, _true = self._bucketer.bucket_feed(feed)
+        pad_rows = 0
+        for a in feed.values():
+            if getattr(a, 'ndim', 0) >= 1:
+                pad_rows = max(0, int(a.shape[0]) - total_rows)
+                break
+        t_dev0 = time.perf_counter()
+        if batch_ctx is not None:
+            for r in batch:
+                if r.trace is not None:
+                    # dispatch child: coalesce + pad onto the bucket
+                    _obs.tracing.recorder().add_complete(
+                        'serving.dispatch', t0, t_dev0, cat='serving',
+                        args={'trace_id': r.trace.trace_id,
+                              'parent_span_id': r.trace.span_id,
+                              'batch_span_id': batch_ctx.span_id,
+                              'pad_rows': int(pad_rows)})
         try:
             if _faults.any_active():
                 _faults.maybe_fail('serve_dispatch')
-            outs = self._backend(feed)
+            with contextlib.ExitStack() as ctxs:
+                if batch_ctx is not None:
+                    # executor/predictor spans under this dispatch join
+                    # the batch trace via the ambient context
+                    ctxs.enter_context(_tc.use(batch_ctx))
+                if mode in ('slow', 'probe'):
+                    # degraded-mode dispatches are intentionally slow —
+                    # their launch gaps are not pipeline stalls
+                    ctxs.enter_context(
+                        _obs.stall.suppress('breaker_%s' % mode))
+                outs = self._backend(feed)
         except BaseException as e:  # noqa: BLE001 - replied per request
             self.breaker.record_failure()
             _obs.metrics.counter('serving.batch_failures').inc()
+            t_fail = time.perf_counter()
+            if batch_ctx is not None:
+                self._emit_device_spans(batch, batch_ctx, t_dev0, t_fail)
+                self._emit_batch_span(batch, batch_ctx, t0, t_fail, mode,
+                                      total_rows, pad_rows, cold, ERROR)
+            _flight.record('serving.batch_failure', error=repr(e)[:300],
+                           rows=int(total_rows), requests=len(batch),
+                           mode=mode or 'normal')
             for r in batch:
                 self._resolve(r, ERROR, error=e, reason='dispatch')
+            _flight.maybe_dump('serving_batch_failure')
             return
+        t_dev1 = time.perf_counter()
         if self._compile_marks() > marks:
             cold = True
         if cold:
@@ -548,6 +692,8 @@ class ServingEngine(object):
             self.breaker.record_cold()
         self.breaker.record_success(cold=cold)
         outs = [np.asarray(o) for o in outs]
+        if batch_ctx is not None:
+            self._emit_device_spans(batch, batch_ctx, t_dev0, t_dev1)
         # scatter: per-row outputs slice back to their request; outputs
         # without the batch leading dim (batch-aggregate fetches) are
         # handed to every request whole
@@ -565,8 +711,24 @@ class ServingEngine(object):
         if mode == 'slow':
             _obs.metrics.counter('serving.slow_path_batches').inc()
         _obs.metrics.histogram('serving.batch_rows').observe(total_rows)
+        t_end = time.perf_counter()
+        if batch_ctx is not None:
+            self._emit_batch_span(batch, batch_ctx, t0, t_end, mode,
+                                  total_rows, pad_rows, cold, OK)
         _obs.metrics.histogram('serving.batch_ms').observe(
-            (time.perf_counter() - t0) * 1e3)
+            (t_end - t0) * 1e3)
+
+    def _emit_device_spans(self, batch, batch_ctx, t_dev0, t_dev1):
+        """Per-request `serving.device` child: the backend-call window
+        (compile miss + device time) the request rode in."""
+        rec = _obs.tracing.recorder()
+        for r in batch:
+            if r.trace is not None:
+                rec.add_complete(
+                    'serving.device', t_dev0, t_dev1, cat='serving',
+                    args={'trace_id': r.trace.trace_id,
+                          'parent_span_id': r.trace.span_id,
+                          'batch_span_id': batch_ctx.span_id})
 
     # ----------------------------------------------------- resolution
     def _resolve(self, req, status, outputs=None, error=None, reason=None):
@@ -577,6 +739,9 @@ class ServingEngine(object):
             return
         with self._out_lock:
             self._outstanding.discard(req)
+        # exactly one root span per request, status = the terminal reply
+        self._emit_root_span(req.trace, req.t_pc, status, reason=reason,
+                             rows=req.rows)
         if status == OK:
             _obs.metrics.counter('serving.completed').inc()
             _obs.metrics.histogram('serving.latency_ms').observe(

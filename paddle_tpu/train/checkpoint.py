@@ -136,8 +136,14 @@ class Checkpointer(object):
         t0 = time.perf_counter() if obs_on else None
         arrays = {n: np.array(scope.get(n), copy=True) for n in names}
         if obs_on:
+            # host-memory accounting: each queued snapshot pins this many
+            # bytes of host RAM until its background write drains
+            nbytes = sum(a.nbytes for a in arrays.values())
+            _obs.metrics.gauge('ckpt.snapshot_host_bytes').set(nbytes)
+            _obs.metrics.counter('ckpt.snapshot_bytes_total').inc(nbytes)
             _obs.tracing.add_span('ckpt.snapshot', t0, time.perf_counter(),
-                                  cat='ckpt', args={'arrays': len(arrays)})
+                                  cat='ckpt', args={'arrays': len(arrays),
+                                                    'bytes': nbytes})
         return arrays
 
     def save(self, epoch_id, step_id, extra_meta=None, blocking=None):

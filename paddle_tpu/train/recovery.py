@@ -22,6 +22,8 @@ times is visible for what it is.
 import numpy as np
 
 from .. import observability as _obs
+from ..observability import flight as _flight
+from ..observability import trace_context as _tc
 
 __all__ = ['DivergenceError', 'RecoveryPolicy', 'is_divergence']
 
@@ -101,6 +103,10 @@ class RecoveryPolicy(object):
             _obs.metrics.counter('recovery.divergences').inc()
             if self._consecutive > self.max_retries:
                 _obs.metrics.counter('recovery.giveups').inc()
+                _flight.record('recovery.giveup', error=repr(e)[:300],
+                               consecutive=self._consecutive)
+                # the re-raise kills the run; leave the postmortem behind
+                _flight.maybe_dump('recovery_giveup')
                 raise
             self.rollback(reason=repr(e)[:200])
             _obs.metrics.counter('recovery.skipped_steps').inc()
@@ -111,22 +117,29 @@ class RecoveryPolicy(object):
         counters) and optionally scale the LR down.  Raises if there is
         no valid checkpoint — recovery without a restore point would mean
         silently training on poisoned state."""
-        meta = self.checkpointer.restore()
-        if meta is None:
-            _obs.metrics.counter('recovery.no_checkpoint').inc()
-            raise RuntimeError(
-                'divergence recovery failed: no valid checkpoint to roll '
-                'back to (save one before training starts)')
-        _obs.metrics.counter('recovery.rollbacks').inc()
-        _obs.tracing.instant('recovery.rollback', cat='recovery',
-                             args={'to_step': meta.get('step_id'),
-                                   'reason': reason})
-        if self.lr_var and self.lr_scale:
-            scope = self.checkpointer._scope()
-            if self.lr_var in scope:
-                lr = np.asarray(scope.get(self.lr_var))
-                scope.set(self.lr_var, (lr * self.lr_scale).astype(lr.dtype))
-                _obs.metrics.counter('recovery.lr_scaled').inc()
+        with _tc.root_span('recovery.rollback', cat='recovery',
+                           args={'reason': reason}):
+            meta = self.checkpointer.restore()
+            if meta is None:
+                _obs.metrics.counter('recovery.no_checkpoint').inc()
+                raise RuntimeError(
+                    'divergence recovery failed: no valid checkpoint to '
+                    'roll back to (save one before training starts)')
+            _obs.metrics.counter('recovery.rollbacks').inc()
+            _obs.tracing.instant('recovery.rollback', cat='recovery',
+                                 args={'to_step': meta.get('step_id'),
+                                       'reason': reason})
+            if self.lr_var and self.lr_scale:
+                scope = self.checkpointer._scope()
+                if self.lr_var in scope:
+                    lr = np.asarray(scope.get(self.lr_var))
+                    scope.set(self.lr_var,
+                              (lr * self.lr_scale).astype(lr.dtype))
+                    _obs.metrics.counter('recovery.lr_scaled').inc()
+        # the restore + replay window is an intentional gap, not a stall:
+        # forget the launch-gap baseline so the first replayed launch is
+        # not measured against the pre-rollback timeline
+        _obs.stall.clear_window(getattr(self.checkpointer, 'executor', None))
         # divergences survive rollback history: a spike right after a
         # rollback should still count toward give-up, but the loss
         # history predates the poisoned step and stays valid

@@ -153,15 +153,31 @@ echo "== ci_smoke: serving soak (continuous batching under chaos) =="
 # request got a terminal reply (admitted == completed + errors +
 # deadline_exceeded + shed), serving.deadlocks == 0, and the shed rate
 # stays under the ceiling.
+#
+# Observability gates ride the same soak (docs/observability.md):
+#   --trace-out      exported Perfetto trace must decompose a request
+#                    into queue/dispatch/device child spans linked to
+#                    its batch span, covering >= 90% of its latency
+#   --metrics-port   /metrics scraped mid-run (serving_admitted_total
+#                    present) and post-drain (accounting identity holds
+#                    in the scraped values)
+#   --expect-flight  the serve_dispatch mid-batch crash must leave a
+#                    flight dump holding that batch's span + the
+#                    fault.injected event (PT_FLIGHT_DIR below)
+flight_dir=$(mktemp -d /tmp/pt_flight.XXXXXX)
 timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 \
+    PT_FLIGHT_DIR="$flight_dir" \
     PT_FAULT="serve_slow_batch:at=1:times=1:s=0.05,serve_dispatch:at=2:times=3,compile_storm:at=12:times=3:s=0.03,queue_overflow:at=30:times=2,sigterm:at=70" \
     python tools/serve_soak.py --requests 80 --qps 150 --clients 2 \
     --deadline-ms 4000 --shed-ceiling 0.35 \
-    --assert-slo --expect-breaker --expect-drain
+    --assert-slo --expect-breaker --expect-drain \
+    --trace-out "$flight_dir/soak_trace.json" --metrics-port 0 \
+    --expect-flight
 serve_rc=$?
 if [ "$serve_rc" -ne 0 ]; then
     echo "ci_smoke: serving soak FAILED (rc=$serve_rc)"
 fi
+rm -rf "$flight_dir"
 
 echo "== ci_smoke: tier-1 tests =="
 set -o pipefail
@@ -226,6 +242,23 @@ tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
     sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
+
+# shared-schema contract (observability/export.py): ALL three emitters
+# (bench.py, serve_soak.py, fault_soak.py) print sections of one SCHEMA
+# table — validate the declarative table itself once, here
+from paddle_tpu.observability import export as obs_export
+if obs_export.schema_keys('bench') != tel_expected:
+    sys.exit('ci_smoke: SCHEMA["bench"] drifted from the expected '
+             'telemetry keys: %r' % (obs_export.schema_keys('bench'),))
+for section, need in (('serving', ('admitted', 'terminal_replies',
+                                   'shed_rate', 'p50_ms', 'p99_ms',
+                                   'counters')),
+                      ('resilience', ('counters',))):
+    have = obs_export.schema_keys(section)
+    absent = [k for k in need if k not in have]
+    if absent:
+        sys.exit('ci_smoke: SCHEMA[%r] is missing keys %s'
+                 % (section, absent))
 if not tel['platform']:
     sys.exit('ci_smoke: telemetry.platform is empty — the bench no longer '
              'self-labels the backend it ran on')

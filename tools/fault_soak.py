@@ -44,8 +44,11 @@ def main():
     import paddle_tpu as fluid
     import paddle_tpu.observability as obs
     from paddle_tpu.data_feeder import FeedPrefetcher
+    from paddle_tpu.observability import flight as _flight
     from paddle_tpu.train import (CheckpointConfig, Checkpointer,
                                   RecoveryPolicy)
+
+    _flight.install()   # an uncaught crash still leaves a postmortem
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = 17
@@ -129,12 +132,9 @@ def main():
         'steps_done': len(losses),
         'steps_skipped': skipped,
         'losses_finite': bool(np.all(np.isfinite(losses))),
-        'counters': {k: c.get(k) or 0 for k in (
-            'faults.injected', 'recovery.rollbacks', 'recovery.divergences',
-            'recovery.skipped_steps', 'ckpt.saves', 'ckpt.write_failures',
-            'ckpt.torn_deleted', 'ckpt.restores', 'retry.attempts',
-            'executor.retraces', 'executor.stall_count',
-            'prefetch.starvation_count', 'kernel.fallbacks')},
+        # shared schema: observability/export.py SCHEMA['resilience']
+        'counters': obs.telemetry_snapshot('resilience',
+                                           snapshot=c)['counters'],
         'retraces_after_recovery': retraces_after_recovery,
         'steady_state_stalls': steady_stalls,
     }

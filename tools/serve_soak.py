@@ -31,7 +31,26 @@ Asserted SLOs (--assert-slo), all from ``serving.*`` metrics:
   * SIGTERM drain observed: handler ran, engine STOPPED, post-drain
     submissions refused (--expect-drain)
 
-Prints one JSON line with the verdict and the metrics that prove it.
+Observability gates (docs/observability.md):
+  * --trace-out PATH exports the Perfetto trace and VERIFIES it: a
+    chosen successful request has exactly ONE `serving.request` root
+    span, that root links (via its children's batch_span_id) to a
+    `serving.batch` span whose `links` carry the request's trace id,
+    and the queue_wait + dispatch + device child spans cover >= 90% of
+    the root span's duration — the trace actually answers "why was
+    this request slow".
+  * --metrics-port N starts the engine-owned /metrics endpoint; the
+    soak scrapes it mid-run (serving_admitted_total present) and again
+    post-drain, asserting the scraped accounting identity
+    admitted == completed + errors + deadline_exceeded + shed.
+  * --expect-flight requires a flight-recorder dump in PT_FLIGHT_DIR
+    containing at least one `serving.batch` span and a
+    `fault.injected` serve_dispatch event (the mid-batch crash left a
+    usable postmortem).
+
+Prints one JSON line with the verdict and the metrics that prove it
+(the serving block comes from observability.telemetry_snapshot, the
+same schema bench.py and fault_soak.py print).
 """
 import argparse
 import json
@@ -101,13 +120,26 @@ def main():
                     help='require breaker tripped AND recovered')
     ap.add_argument('--expect-drain', action='store_true',
                     help='require a SIGTERM-initiated drain was observed')
+    ap.add_argument('--trace-out', default=None, metavar='PATH',
+                    help='export the Perfetto trace here and verify a '
+                         'request decomposes into queue/dispatch/device '
+                         'child spans linked to its batch span')
+    ap.add_argument('--metrics-port', type=int, default=None,
+                    help='engine-owned /metrics port (0 = ephemeral); '
+                         'the soak scrapes it mid-run and post-drain')
+    ap.add_argument('--expect-flight', action='store_true',
+                    help='require a flight dump with a serving.batch '
+                         'span and a serve_dispatch fault event')
     args = ap.parse_args()
 
     import numpy as np
     import paddle_tpu.observability as obs
     from paddle_tpu import serving
     from paddle_tpu.data_feeder import FeedBucketer
+    from paddle_tpu.observability import flight as _flight
     from paddle_tpu.testing import faults as _faults
+
+    _flight.install()   # an uncaught crash still leaves a postmortem
 
     import tempfile
     tmpdir = tempfile.mkdtemp(prefix='pt_serve_soak.')
@@ -121,7 +153,8 @@ def main():
             max_queue=args.max_queue, overflow_policy=args.policy,
             max_batch_rows=32, batch_linger_s=0.002,
             breaker_failure_threshold=3, breaker_storm_threshold=3,
-            breaker_cooldown_s=0.2, drain_timeout_s=20.0))
+            breaker_cooldown_s=0.2, drain_timeout_s=20.0,
+            metrics_port=args.metrics_port))
 
     # the soak's own SIGTERM recorder goes in FIRST so the engine's
     # drain handler (installed second) chains to it — the process stays
@@ -178,6 +211,32 @@ def main():
             # a real batch to run against
             time.sleep(0.05)
 
+    def scrape(path='/metrics'):
+        import urllib.request
+        url = 'http://127.0.0.1:%d%s' % (engine.metrics_port, path)
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return resp.read().decode()
+
+    def prom_values(text):
+        out = {}
+        for line in text.splitlines():
+            if line.startswith('#') or not line.strip():
+                continue
+            parts = line.split()
+            if len(parts) == 2 and '{' not in parts[0]:
+                out[parts[0]] = float(parts[1])
+        return out
+
+    # mid-soak scrape: the endpoint must be live DURING traffic (an
+    # exact accounting identity waits for the post-drain scrape —
+    # in-flight requests make it inexact here)
+    mid_scrape_ok = None
+    if args.metrics_port is not None:
+        if engine.metrics_port is None:
+            sys.exit('serve_soak: --metrics-port set but the engine did '
+                     'not start a metrics server (is PT_OBS=0?)')
+        mid_scrape_ok = 'serving_admitted_total' in prom_values(scrape())
+
     drained = engine.drain()
     stop_clients.set()
     for t in clients:
@@ -185,7 +244,6 @@ def main():
 
     # ---------------------------------------------------------- audit
     statuses = {}
-    latencies_ok = []
     no_reply = 0
     with fut_lock:
         all_futs = list(futures)
@@ -195,41 +253,26 @@ def main():
             continue
         res = fut.result(0)
         statuses[res.status] = statuses.get(res.status, 0) + 1
-        if res.status == 'ok':
-            latencies_ok.append(res.latency_s * 1e3)
 
-    c = obs.counters()
-
-    def cnt(name):
-        return int(c.get(name) or 0)
-
-    admitted = cnt('serving.admitted')
-    terminal = (cnt('serving.completed') + cnt('serving.errors') +
-                cnt('serving.deadline_exceeded') + cnt('serving.shed'))
-    shed_rate = cnt('serving.shed') / float(max(1, admitted))
-    p50 = float(np.percentile(latencies_ok, 50)) if latencies_ok else None
-    p99 = float(np.percentile(latencies_ok, 99)) if latencies_ok else None
+    # the serving block comes straight from the shared schema; p50/p99
+    # read the serving.latency_ms bounded histogram (observed only for
+    # OK replies — the same population the old in-process list held)
+    tel = obs.telemetry_snapshot('serving')
+    admitted = tel['admitted']
+    terminal = tel['terminal_replies']
+    shed_rate = tel['shed_rate']
+    p99 = tel['p99_ms']
 
     rec = {
         'requests_submitted': len(all_futs),
         'statuses': statuses,
         'no_reply': no_reply,
-        'admitted': admitted,
-        'terminal_replies': terminal,
-        'shed_rate': round(shed_rate, 4),
-        'p50_ms': p50,
-        'p99_ms': p99,
-        'breaker_trips': cnt('serving.breaker_trips'),
-        'breaker_recoveries': cnt('serving.breaker_recoveries'),
-        'deadlocks': cnt('serving.deadlocks'),
         'sigterm_seen': sigterm_seen[0],
         'drained': bool(drained),
         'state': engine.state,
-        'counters': {k: c.get(k) for k in sorted(c)
-                     if k.startswith('serving.')
-                     or k == 'bucketer.bucket_count'
-                     or k.startswith('faults.')},
+        'mid_scrape_ok': mid_scrape_ok,
     }
+    rec.update(tel)
     print(json.dumps(rec))
 
     if args.assert_slo:
@@ -242,10 +285,10 @@ def main():
             sys.exit('serve_soak: terminal replies (%d) != admitted (%d) '
                      '— a request was dropped without a reply'
                      % (terminal, admitted))
-        if not latencies_ok:
+        if not statuses.get('ok'):
             sys.exit('serve_soak: zero successful requests — no p99 to '
                      'measure')
-        if not np.isfinite(p99):
+        if p99 is None or not np.isfinite(p99):
             sys.exit('serve_soak: p99 is not finite: %r' % p99)
         if shed_rate > args.shed_ceiling:
             sys.exit('serve_soak: shed rate %.3f above the ceiling %.3f'
@@ -268,6 +311,95 @@ def main():
         if probe.status != 'rejected':
             sys.exit('serve_soak: post-drain submit was not refused '
                      '(%s)' % probe.status)
+
+    # ------------------------------------------- /metrics scrape gate
+    if args.metrics_port is not None:
+        if not mid_scrape_ok:
+            sys.exit('serve_soak: mid-soak /metrics scrape missing '
+                     'serving_admitted_total')
+        # post-drain the queue is empty, so the scraped identity must
+        # be EXACT: every admitted request reached one terminal counter
+        pv = prom_values(scrape())
+        s_adm = pv.get('serving_admitted_total', -1)
+        s_term = (pv.get('serving_completed_total', 0) +
+                  pv.get('serving_errors_total', 0) +
+                  pv.get('serving_deadline_exceeded_total', 0) +
+                  pv.get('serving_shed_total', 0))
+        if int(s_adm) != int(s_term):
+            sys.exit('serve_soak: scraped accounting identity broken: '
+                     'admitted=%d != terminal=%d' % (s_adm, s_term))
+
+    # --------------------------------------------- trace export gate
+    if args.trace_out:
+        path = obs.export_chrome_trace(args.trace_out)
+        with open(path) as f:
+            events = json.load(f)['traceEvents']
+        ok_tids = [f_.traceparent.split('-')[1] for f_ in all_futs
+                   if f_.done() and f_.result(0).status == 'ok'
+                   and f_.traceparent]
+        if not ok_tids:
+            sys.exit('serve_soak: --trace-out with zero ok requests')
+        verified = None
+        for tid in ok_tids:
+            roots = [e for e in events
+                     if e.get('name') == 'serving.request'
+                     and e.get('args', {}).get('trace_id') == tid]
+            if len(roots) != 1:
+                sys.exit('serve_soak: trace %s has %d serving.request '
+                         'root spans (want exactly 1)' % (tid, len(roots)))
+            kids = {e['name']: e for e in events
+                    if e.get('name') in ('serving.queue_wait',
+                                         'serving.dispatch',
+                                         'serving.device')
+                    and e.get('args', {}).get('trace_id') == tid}
+            if len(kids) != 3:
+                continue   # ring may have evicted an early request
+            batch_sid = kids['serving.queue_wait']['args']['batch_span_id']
+            batches = [e for e in events if e.get('name') == 'serving.batch'
+                       and e.get('args', {}).get('span_id') == batch_sid]
+            if len(batches) != 1 or \
+                    tid not in batches[0]['args'].get('links', ()):
+                sys.exit('serve_soak: trace %s: batch span %s missing or '
+                         'not linking the request' % (tid, batch_sid))
+            covered = sum(k['dur'] for k in kids.values())
+            if covered < 0.9 * roots[0]['dur']:
+                sys.exit('serve_soak: trace %s: child spans cover %.1f%% '
+                         'of the root span (want >= 90%%)'
+                         % (tid, 100.0 * covered / max(roots[0]['dur'],
+                                                       1e-9)))
+            verified = tid
+            break
+        if verified is None:
+            sys.exit('serve_soak: no ok request had a full '
+                     'queue/dispatch/device decomposition in the trace')
+        print('serve_soak: trace verified for request %s -> %s'
+              % (verified, path), file=sys.stderr)
+
+    # ------------------------------------------- flight recorder gate
+    if args.expect_flight:
+        fdir = _flight.flight_dir()
+        if not fdir:
+            sys.exit('serve_soak: --expect-flight needs PT_FLIGHT_DIR')
+        dumps = sorted(fn for fn in os.listdir(fdir)
+                       if fn.startswith('flight_') and fn.endswith('.json'))
+        if not dumps:
+            sys.exit('serve_soak: no flight dump in %s' % fdir)
+        found_batch = found_fault = False
+        for fn in dumps:
+            with open(os.path.join(fdir, fn)) as f:
+                art = json.load(f)
+            evs = art.get('events', [])
+            found_batch = found_batch or any(
+                e.get('name') == 'serving.batch' for e in evs)
+            found_fault = found_fault or any(
+                e.get('name') == 'fault.injected'
+                and e.get('args', {}).get('site') == 'serve_dispatch'
+                for e in evs)
+        if not (found_batch and found_fault):
+            sys.exit('serve_soak: flight dump(s) missing %s' % ', '.join(
+                n for n, ok in (('serving.batch span', found_batch),
+                                ('serve_dispatch fault event', found_fault))
+                if not ok))
     return 0
 
 
