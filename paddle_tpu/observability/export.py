@@ -128,8 +128,12 @@ SCHEMA = {
     'resilience': (
         ('counters', ('block_names', (
             'faults.injected', 'recovery.rollbacks', 'recovery.divergences',
-            'recovery.skipped_steps', 'ckpt.saves', 'ckpt.write_failures',
-            'ckpt.torn_deleted', 'ckpt.restores', 'retry.attempts',
+            'recovery.skipped_steps', 'recovery.device_loss', 'ckpt.saves',
+            'ckpt.write_failures', 'ckpt.torn_deleted', 'ckpt.restores',
+            'ckpt.corrupt_skipped', 'ckpt.shard_writes',
+            'ckpt.shard_manifests', 'ckpt.partial_swept', 'ckpt.reshards',
+            'ckpt.desync_dropped', 'health.beats', 'health.trips',
+            'health.lost_hosts', 'health.desyncs', 'retry.attempts',
             'executor.retraces', 'executor.stall_count',
             'prefetch.starvation_count', 'kernel.fallbacks'))),
     ),

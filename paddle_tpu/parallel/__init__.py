@@ -8,6 +8,8 @@ annotations over a `jax.sharding.Mesh`; XLA GSPMD inserts the collectives
 """
 from .mesh import make_mesh, default_mesh, set_default_mesh, shard_map  # noqa
 from .parallel_executor import ParallelExecutor  # noqa
+from .health import (HealthConfig, HealthMonitor,  # noqa
+                     DeviceLossError, HostDesyncError, RESTART_EXIT_CODE)
 from .tp import shard_program_tp, annotate  # noqa
 from .ring_attention import ring_attention, ring_attention_sharded  # noqa
 from .pipeline import pipeline_apply, stack_stage_params  # noqa

@@ -1,0 +1,236 @@
+"""Device-health watchdog for pod-scale training.
+
+A pod participant that dies (preemption, kernel panic, a wedged PJRT
+runtime) does not return an error — it simply stops answering, and every
+peer blocks forever in the next collective.  The watchdog turns that
+silent hang into a typed, recoverable failure:
+
+  * every participant ``beat(step)``s a per-host heartbeat file
+    (JSON, atomic tmp+rename) into a shared ``health_dir`` each step;
+  * every participant ``check(step)``s the roster: a peer whose beat has
+    gone stale for ``timeout_s`` raises :class:`DeviceLossError`; a peer
+    whose reported step runs ``desync_steps`` ahead raises
+    :class:`HostDesyncError` (a drifted host corrupts lockstep
+    semantics long before it hangs);
+  * a trip records + dumps the flight recorder (postmortems cover pod
+    failures) and is STICKY — once lost, always lost, so a background
+    poller and the step loop cannot disagree.
+
+`RecoveryPolicy` (train/recovery.py) treats :class:`DeviceLossError` as
+a pod fault, not a divergence: it rolls the scope back to the last good
+manifest and RE-RAISES, and the trainer process exits with
+``RESTART_EXIT_CODE`` so its supervisor respawns it — typically on a
+smaller roster (the elastic restore re-slices the manifest onto
+whatever mesh comes up).  ``tools/pod_soak.py`` drives exactly that
+loop under the ``device_loss`` / ``host_desync`` fault sites.
+
+Heartbeats are plain files on the shared checkpoint volume — no extra
+transport, works under multiprocess CPU testing, and the staleness
+clock is injectable (``time_fn``) so the unit tests never sleep.
+A finished participant calls :meth:`HealthMonitor.mark_done` so peers
+still training do not mistake a clean exit for a loss.
+"""
+import json
+import os
+import threading
+import time
+
+from .. import observability as _obs
+from ..observability import flight as _flight
+from ..testing import faults as _faults
+
+__all__ = ['DeviceLossError', 'HostDesyncError', 'HealthConfig',
+           'HealthMonitor', 'RESTART_EXIT_CODE']
+
+# sysexits.h EX_TEMPFAIL: "try again (on a smaller mesh)" — the contract
+# between a tripped worker and its supervisor (tools/pod_soak.py)
+RESTART_EXIT_CODE = 75
+
+# step skew the host_desync fault injects (kept in sync with
+# train/checkpoint.py): far past any plausible desync_steps tolerance
+_DESYNC_SKEW = 10000
+
+
+class DeviceLossError(RuntimeError):
+    """A pod participant stopped heartbeating: treat the collective as
+    dead, roll back, restart on the surviving mesh."""
+
+
+class HostDesyncError(DeviceLossError):
+    """A participant's reported step drifted out of the lockstep window —
+    its collectives (and its checkpoint shards) no longer describe the
+    same training state as the rest of the roster."""
+
+
+class HealthConfig(object):
+    def __init__(self, health_dir, host_id=None, host_count=None,
+                 timeout_s=5.0, desync_steps=500):
+        self.health_dir = health_dir
+        if host_id is None:
+            host_id = int(os.environ.get('PT_HOST_ID', '0'))
+        if host_count is None:
+            host_count = int(os.environ.get('PT_HOST_COUNT', '1'))
+        self.host_id = int(host_id)
+        self.host_count = max(1, int(host_count))
+        if not 0 <= self.host_id < self.host_count:
+            raise ValueError('host_id %d not in roster of %d host(s)'
+                             % (self.host_id, self.host_count))
+        self.timeout_s = float(timeout_s)
+        self.desync_steps = int(desync_steps)
+
+
+class HealthMonitor(object):
+    """Heartbeat writer + roster checker for one pod participant."""
+
+    def __init__(self, config, time_fn=time.time, on_trip=None):
+        if isinstance(config, str):
+            config = HealthConfig(config)
+        self.config = config
+        self._time = time_fn
+        self.on_trip = on_trip
+        self._hung = False       # device_loss injected: stop beating
+        self._tripped = None     # sticky: first trip wins
+        self._my_step = None
+        self._seen = {}          # host -> last beat read (joined peers)
+        self._poller = None
+        self._stop = threading.Event()
+        os.makedirs(config.health_dir, exist_ok=True)
+
+    def path_of(self, host):
+        return os.path.join(self.config.health_dir, 'host_%d.json' % host)
+
+    # ------------------------------------------------------------- beat
+    def beat(self, step, done=False):
+        """Write this host's heartbeat.  Returns False when the armed
+        ``device_loss`` fault fires — the caller should then act like a
+        lost device (hang or exit without cleanup), NOT keep beating."""
+        if self._hung:
+            return False
+        step = int(step)
+        if _faults.fire('device_loss', step):
+            # a lost device goes silent mid-step: no further beats, no
+            # goodbye — peers must detect the staleness
+            self._hung = True
+            _flight.record('health.device_loss_injected',
+                           host=self.config.host_id, step=step)
+            return False
+        rec_step = step
+        if _faults.fire('host_desync', step):
+            # a drifted host: heartbeat claims a far-future step
+            rec_step = step + _DESYNC_SKEW
+        rec = {'host': self.config.host_id, 'step': rec_step,
+               'time': float(self._time()), 'pid': os.getpid(),
+               'done': bool(done)}
+        path = self.path_of(self.config.host_id)
+        tmp = '%s.tmp%d' % (path, os.getpid())
+        with open(tmp, 'w') as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+        self._my_step = step
+        _obs.metrics.counter('health.beats').inc()
+        return True
+
+    def mark_done(self):
+        """Final heartbeat flagging a CLEAN exit: peers still training
+        treat this host as healthy forever instead of tripping on its
+        (now permanently stale) beat."""
+        if self._my_step is not None:
+            self.beat(self._my_step, done=True)
+
+    # ------------------------------------------------------------ check
+    def _read(self, host):
+        try:
+            with open(self.path_of(host)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None   # not yet joined, or mid-rename
+
+    def snapshot(self):
+        """{host: last heartbeat record} for every roster member that
+        has ever beaten."""
+        out = {}
+        for h in range(self.config.host_count):
+            rec = self._read(h)
+            if rec is not None:
+                out[h] = rec
+        return out
+
+    def check(self, step=None):
+        """Scan the roster; raise on a lost or desynced peer.  A peer
+        that has NEVER beaten is treated as not-yet-joined (startup is
+        not a loss); a peer marked done is healthy forever.  Trips are
+        sticky — every later check re-raises the first verdict."""
+        if self._tripped is not None:
+            raise self._tripped
+        cfg = self.config
+        now = float(self._time())
+        mine = int(step) if step is not None else self._my_step
+        for h in range(cfg.host_count):
+            if h == cfg.host_id:
+                continue
+            rec = self._read(h)
+            if rec is None:
+                if h in self._seen and not self._seen[h].get('done'):
+                    self._trip(DeviceLossError(
+                        'host %d heartbeat file disappeared' % h),
+                        kind='device_loss', host=h)
+                continue
+            self._seen[h] = rec
+            if rec.get('done'):
+                continue
+            age = now - float(rec.get('time', 0.0))
+            if age > cfg.timeout_s:
+                self._trip(DeviceLossError(
+                    'host %d lost: last heartbeat %.2fs ago (> %.2fs) at '
+                    'step %s' % (h, age, cfg.timeout_s, rec.get('step'))),
+                    kind='device_loss', host=h, age=age)
+            if mine is not None and \
+                    int(rec.get('step', 0)) - mine > cfg.desync_steps:
+                self._trip(HostDesyncError(
+                    'host %d desynced: reports step %s, local step %d '
+                    '(tolerance %d)' % (h, rec.get('step'), mine,
+                                        cfg.desync_steps)),
+                    kind='host_desync', host=h)
+
+    def _trip(self, exc, kind, **args):
+        _obs.metrics.counter('health.trips').inc()
+        _obs.metrics.counter(
+            'health.desyncs' if kind == 'host_desync'
+            else 'health.lost_hosts').inc()
+        _obs.tracing.instant('health.trip', cat='health',
+                             args=dict(args, kind=kind))
+        _flight.record('health.trip', trip=kind, error=str(exc), **args)
+        # the postmortem must exist even if the raise below kills the
+        # run before any give-up handler runs
+        _flight.maybe_dump('health_trip')
+        self._tripped = exc
+        if self.on_trip is not None:
+            self.on_trip(exc)
+        raise exc
+
+    # ------------------------------------------------------- background
+    def start(self, poll_s=0.2):
+        """Optional background poller: detects a loss while the step
+        loop is blocked (e.g. inside a hung collective).  The verdict is
+        sticky, so the loop's own next ``check()`` re-raises it; an
+        ``on_trip`` callback can additionally interrupt the block."""
+        if self._poller is not None and self._poller.is_alive():
+            return
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(poll_s):
+                try:
+                    self.check()
+                except DeviceLossError:
+                    return   # sticky verdict recorded; poller retires
+
+        self._poller = threading.Thread(target=_loop, name='HealthPoller',
+                                        daemon=True)
+        self._poller.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2.0)
+            self._poller = None

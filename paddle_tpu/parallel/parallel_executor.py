@@ -42,6 +42,21 @@ class ParallelExecutor(object):
     def device_count(self):
         return int(np.prod(self._mesh.devices.shape))
 
+    @property
+    def mesh(self):
+        """The device mesh this executor launches over — Checkpointer
+        records it in the manifest so an elastic restore can tell a
+        reshard from a same-shape resume."""
+        return self._mesh
+
+    # Checkpointer duck-type: full bitwise-resume state lives in the
+    # wrapped Executor's RNG/run counters
+    def rng_state(self):
+        return self._exe.rng_state()
+
+    def set_rng_state(self, state):
+        return self._exe.set_rng_state(state)
+
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict
         return self._exe.run(self._main_program, feed=feed,

@@ -53,6 +53,19 @@ Instrumented sites (kept in sync with docs/robustness.md):
   ``compile_storm``  a batch is treated as a cold-compile: the dispatch
                    thread sleeps ``s`` seconds and the breaker counts a
                    cold batch — enough consecutive ones trip it
+  ``ckpt_io``      a single checkpoint disk write raises OSError INSIDE
+                   the retried callable — unlike ``ckpt_write`` (a
+                   simulated crash) this is a transient blip that
+                   ``retry_with_backoff`` must absorb
+                   (train/checkpoint.py)
+  ``device_loss``  a pod participant stops heartbeating at step ``at``
+                   and hangs — peers must detect the loss and trip
+                   recovery instead of waiting on a dead collective
+                   (parallel/health.py)
+  ``host_desync``  a participant's heartbeat (and its shard META) report
+                   a step far ahead of the roster — the desync guard
+                   must refuse to commit a mixed-step checkpoint
+                   (parallel/health.py, train/checkpoint.py)
   ===============  ====================================================
 """
 import os
@@ -66,9 +79,10 @@ __all__ = ['configure', 'reset', 'any_active', 'active', 'fire', 'fire_in',
            'maybe_fail', 'maybe_sleep', 'maybe_kill', 'poison_nan',
            'InjectedFault', 'SITES']
 
-SITES = ('ckpt_write', 'cache_read', 'cache_write', 'io_read', 'io_write',
-         'nan_step', 'prefetch_stall', 'sigterm', 'serve_dispatch',
-         'serve_slow_batch', 'queue_overflow', 'compile_storm')
+SITES = ('ckpt_write', 'ckpt_io', 'cache_read', 'cache_write', 'io_read',
+         'io_write', 'nan_step', 'prefetch_stall', 'sigterm',
+         'serve_dispatch', 'serve_slow_batch', 'queue_overflow',
+         'compile_storm', 'device_loss', 'host_desync')
 
 
 class InjectedFault(OSError):

@@ -28,11 +28,32 @@ contrib fault-tolerance hooks — grown into a resilience primitive:
   * **Preemption flush.**  ``install_signal_handlers()`` arms SIGTERM/
     SIGINT to flush one final blocking checkpoint at the last recorded
     progress before the previous handler (or default death) runs.
+  * **Sharded pod mode.**  With ``CheckpointConfig(host_count=H,
+    host_id=h)`` each host snapshots and writes only ITS row-slice of
+    every persistable (``arrays_<h>.npz`` — host RAM and disk I/O scale
+    as 1/H) into a shared ``checkpoint_<serial>.parts`` staging dir.
+    The last host to land its shard **finalizes** the serial under the
+    ``ckpt.lock`` advisory lock: it verifies the roster is complete and
+    step-consistent, writes ``MANIFEST.json`` (global shapes, shard
+    index map, per-file SHA-256, mesh axes, writer roster, sharding
+    specs), then marks and renames the dir — so a committed checkpoint
+    is all-hosts-or-nothing, and a partial one is swept as a unit.
+    Serials are derived from the global step (``step_id + 1``) so
+    lockstep hosts converge on the same dir without communication.
+  * **Elastic restore.**  ``restore()`` reassembles global arrays from
+    any manifest (every shard file checksum-verified first) and loads
+    them onto the CURRENT config — an H-host checkpoint resumes on 1
+    host or vice versa; a mesh/roster change counts ``ckpt.reshards``.
 
-Rotation keeps the newest ``max_num_checkpoints`` *valid* dirs.  The
-``ckpt_write`` fault site (testing/faults.py) tears a write between the
-tensor file and the marker, which is how the torn-scan path stays tested.
+Rotation keeps the newest ``max_num_checkpoints`` *valid* dirs, under
+the same ``ckpt.lock`` so two writers sharing a dir cannot sweep each
+other's newest-K.  Real disk writes go through ``retry_with_backoff``
+(transient OSError absorbed — the ``ckpt_io`` fault site rehearses
+this); the ``ckpt_write`` fault site still simulates a CRASH between
+the tensor file and the marker/sidecar, which is how the torn-scan and
+partial-sweep paths stay tested.
 """
+import hashlib
 import json
 import os
 import queue
@@ -43,10 +64,17 @@ import threading
 import time
 import warnings
 
+try:
+    import fcntl
+except ImportError:  # non-POSIX: advisory locking degrades to thread-only
+    fcntl = None
+
 import numpy as np
 
 from .. import observability as _obs
 from ..core import signals as _signals
+from ..core.retry import retry_with_backoff
+from ..observability import flight as _flight
 from ..testing import faults as _faults
 
 __all__ = ['CheckpointConfig', 'Checkpointer']
@@ -54,12 +82,88 @@ __all__ = ['CheckpointConfig', 'Checkpointer']
 _SUCCESS = '_SUCCESS'
 _META = 'META'
 _ARRAYS = '__params__.npz'   # same file the io.save_persistables path used
+_MANIFEST = 'MANIFEST.json'
+_SHARD_META = 'shard_%d.json'
+_SHARD_NPZ = 'arrays_%d.npz'
+_PARTS = '.parts'            # staging suffix for multi-host serials
+_LOCKFILE = 'ckpt.lock'
+_FORMAT = 'ptckpt-sharded-1'
+# step skew the host_desync fault injects into a sidecar/heartbeat (kept
+# in sync with parallel/health.py): far past any desync tolerance
+_DESYNC_SKEW = 10000
+
+
+def _sha256_file(path):
+    h = hashlib.sha256()
+    n = 0
+    with open(path, 'rb') as f:
+        for chunk in iter(lambda: f.read(1 << 20), b''):
+            h.update(chunk)
+            n += len(chunk)
+    return h.hexdigest(), n
+
+
+def _write_json_atomic(path, obj):
+    tmp = '%s.tmp%d' % (path, os.getpid())
+    with open(tmp, 'w') as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+class _DirLock(object):
+    """Advisory inter-process lock on a file inside the checkpoint dir,
+    re-entrant within a thread.  flock() is per open-file-description,
+    so the writer thread and a signal-flush in the main thread must not
+    share one fd — a plain threading.RLock in front serializes them."""
+
+    def __init__(self, path, timeout_s=30.0):
+        self.path = path
+        self.timeout_s = float(timeout_s)
+        self._tlock = threading.RLock()
+        self._depth = 0
+        self._fd = None
+
+    def __enter__(self):
+        self._tlock.acquire()
+        self._depth += 1
+        if self._depth > 1 or fcntl is None:
+            return self
+        os.makedirs(os.path.dirname(self.path) or '.', exist_ok=True)
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    os.close(fd)
+                    self._depth -= 1
+                    self._tlock.release()
+                    raise RuntimeError(
+                        'timed out (%.1fs) waiting for checkpoint lock %s '
+                        '— another process is holding it' %
+                        (self.timeout_s, self.path))
+                time.sleep(0.02)
+        self._fd = fd
+        return self
+
+    def __exit__(self, *exc):
+        if self._depth == 1 and self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+        self._depth -= 1
+        self._tlock.release()
+        return False
 
 
 class CheckpointConfig(object):
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
                  epoch_interval=1, step_interval=10, async_write=True,
-                 strict_writes=False, handle_signals=True):
+                 strict_writes=False, handle_signals=True, sharded=None,
+                 host_id=None, host_count=None, lock_timeout_s=30.0,
+                 stale_parts_s=900.0):
         self.checkpoint_dir = checkpoint_dir or 'checkpoint'
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(1, int(epoch_interval))
@@ -69,6 +173,28 @@ class CheckpointConfig(object):
         # honored by owners that manage a training loop (contrib.Trainer):
         # arm the SIGTERM/SIGINT final-flush handlers on construction
         self.handle_signals = bool(handle_signals)
+        # pod roster: which slice of every persistable THIS process owns
+        if host_id is None:
+            host_id = int(os.environ.get('PT_HOST_ID', '0'))
+        if host_count is None:
+            host_count = int(os.environ.get('PT_HOST_COUNT', '1'))
+        self.host_id = int(host_id)
+        self.host_count = max(1, int(host_count))
+        if not 0 <= self.host_id < self.host_count:
+            raise ValueError('host_id %d not in roster of %d host(s)'
+                             % (self.host_id, self.host_count))
+        # sharded=None: manifest format whenever the roster has >1 host
+        self.sharded = (self.host_count > 1) if sharded is None \
+            else bool(sharded)
+        if self.host_count > 1 and not self.sharded:
+            raise ValueError('a multi-host roster requires sharded mode: '
+                             'the legacy single-file format has no commit '
+                             'protocol for %d writers' % self.host_count)
+        self.lock_timeout_s = float(lock_timeout_s)
+        # a .parts staging dir above the newest valid serial is normally
+        # in flight; older than this, its writer is presumed dead
+        self.stale_parts_s = None if stale_parts_s is None \
+            else float(stale_parts_s)
 
 
 class Checkpointer(object):
@@ -90,6 +216,7 @@ class Checkpointer(object):
         self._warned_write = False
         self._last_progress = None   # (epoch_id, step_id, extra_meta)
         self._prev_handlers = {}
+        self._lockobj = None
 
     # --------------------------------------------------------------- save
     def _dir_of(self, serial):
@@ -101,6 +228,46 @@ class Checkpointer(object):
             return self.scope
         from ..core.executor import global_scope
         return global_scope()
+
+    def dir_lock(self):
+        """The ``ckpt.lock`` advisory lock serializing rotation, torn/
+        partial sweeps, and manifest finalization across every process
+        sharing this checkpoint dir."""
+        if self._lockobj is None:
+            self._lockobj = _DirLock(
+                os.path.join(self.config.checkpoint_dir, _LOCKFILE),
+                self.config.lock_timeout_s)
+        return self._lockobj
+
+    def _mesh_info(self):
+        """Mesh layout riding the manifest: restore compares it against
+        the CURRENT executor's mesh to detect (and count) a reshard."""
+        mesh = getattr(self.executor, 'mesh', None)
+        if mesh is None:
+            return {'axes': [], 'shape': []}
+        try:
+            return {'axes': [str(a) for a in mesh.axis_names],
+                    'shape': [int(s) for s in mesh.devices.shape]}
+        except Exception:
+            return {'axes': [], 'shape': []}
+
+    def _sharding_info(self):
+        """Per-var PartitionSpec annotations (Program.set_sharding) as
+        JSON — placement metadata travels with the artifact, not in
+        runtime state, so a differently-meshed restorer can re-derive
+        its own slicing."""
+        prog = self.main_program
+        sh = getattr(prog, '_sharding', None) if prog is not None else None
+        if not sh:
+            return {}
+        out = {}
+        for name, spec in sh.items():
+            try:
+                out[name] = [None if p is None else str(p)
+                             for p in tuple(spec)]
+            except TypeError:
+                out[name] = [str(spec)]
+        return out
 
     def note_progress(self, epoch_id, step_id, extra_meta=None):
         """Record where training is WITHOUT saving — the signal-flush
@@ -125,7 +292,14 @@ class Checkpointer(object):
         the background writer would serialize freed memory (observed as
         glibc heap corruption).  A forced copy makes the snapshot
         independent of donation, so the writer can run while training
-        continues."""
+        continues.
+
+        Sharded mode copies only THIS host's row-slice (axis 0,
+        ``[h*n//H, (h+1)*n//H)``; 0-d arrays belong to host 0), so the
+        host-RAM pinned per queued snapshot scales as 1/H.  Returns
+        ``(arrays, specs)`` — specs is None in legacy mode, else the
+        global shape/dtype + slice bounds each shard was cut from.
+        """
         scope = self._scope()
         if self.main_program is not None:
             names = [v.name for v in self.main_program.list_vars()
@@ -134,7 +308,29 @@ class Checkpointer(object):
             names = list(scope.keys())
         obs_on = _obs.enabled()
         t0 = time.perf_counter() if obs_on else None
-        arrays = {n: np.array(scope.get(n), copy=True) for n in names}
+        sharded = self.config.sharded
+        h, H = self.config.host_id, self.config.host_count
+        arrays, specs = {}, ({} if sharded else None)
+        for n in names:
+            src = scope.get(n)
+            if not sharded:
+                arrays[n] = np.array(src, copy=True)
+                continue
+            shape = tuple(int(x) for x in np.shape(src))
+            if not shape:
+                if h == 0:
+                    arrays[n] = np.array(src, copy=True)
+                    specs[n] = {'shape': [],
+                                'dtype': str(arrays[n].dtype)}
+                continue
+            lo = shape[0] * h // H
+            hi = shape[0] * (h + 1) // H
+            if lo == hi:
+                continue   # fewer rows than hosts: this host owns none
+            arrays[n] = np.array(src[lo:hi], copy=True)
+            specs[n] = {'shape': list(shape),
+                        'dtype': str(arrays[n].dtype),
+                        'start': lo, 'stop': hi}
         if obs_on:
             # host-memory accounting: each queued snapshot pins this many
             # bytes of host RAM until its background write drains
@@ -144,17 +340,19 @@ class Checkpointer(object):
             _obs.tracing.add_span('ckpt.snapshot', t0, time.perf_counter(),
                                   cat='ckpt', args={'arrays': len(arrays),
                                                     'bytes': nbytes})
-        return arrays
+        return arrays, specs
 
     def save(self, epoch_id, step_id, extra_meta=None, blocking=None):
         """Snapshot now, write in the background (unless ``blocking`` or
         the config says sync).  Returns the directory the checkpoint will
-        land in; ``wait()`` guarantees it is on disk."""
+        land in; ``wait()`` guarantees it is on disk (in sharded mode:
+        that THIS host's shard is on disk — the serial commits once the
+        whole roster has landed)."""
         self.note_progress(epoch_id, step_id, extra_meta)
         self._raise_or_warn_write_error()
         cfg = self.config
         os.makedirs(cfg.checkpoint_dir, exist_ok=True)
-        arrays = self._snapshot()
+        arrays, specs = self._snapshot()
         meta = {'epoch_id': int(epoch_id), 'step_id': int(step_id),
                 'wall_time': time.time()}
         rng = getattr(self.executor, 'rng_state', None)
@@ -162,12 +360,20 @@ class Checkpointer(object):
             meta['rng_state'] = rng()
         if extra_meta:
             meta.update(extra_meta)
-        serial = self._serial + 1
-        self._serial = serial
+        if cfg.sharded:
+            # step-derived serials: lockstep hosts converge on the same
+            # dir with no communication, and stay monotonic across a
+            # restore (the pre-training save(0, -1) lands at serial 0)
+            serial = int(step_id) + 1
+            self._serial = max(self._serial, serial)
+        else:
+            serial = self._serial + 1
+            self._serial = serial
         final_dir = self._dir_of(serial)
+        mesh_info = self._mesh_info() if cfg.sharded else None
         with self._cond:
             self._pending += 1
-        self._q.put((serial, final_dir, arrays, meta))
+        self._q.put((serial, final_dir, arrays, meta, specs, mesh_info))
         if _obs.enabled():
             _obs.metrics.gauge('ckpt.async_queue_depth').set(self._q.qsize())
         self._ensure_thread()
@@ -208,7 +414,11 @@ class Checkpointer(object):
                     self._pending -= 1
                     self._cond.notify_all()
 
-    def _write(self, serial, final_dir, arrays, meta):
+    def _write(self, serial, final_dir, arrays, meta, specs=None,
+               mesh_info=None):
+        if specs is not None:
+            return self._write_sharded(serial, final_dir, arrays, meta,
+                                       specs, mesh_info)
         obs_on = _obs.enabled()
         t0 = time.perf_counter() if obs_on else None
         cfg = self.config
@@ -217,16 +427,26 @@ class Checkpointer(object):
         tmp = tempfile.mkdtemp(dir=cfg.checkpoint_dir,
                                prefix='.tmp_ckpt_%d_' % os.getpid())
         try:
-            np.savez(os.path.join(tmp, _ARRAYS), **arrays)
+            def _tensors():
+                _faults.maybe_fail('ckpt_io')
+                np.savez(os.path.join(tmp, _ARRAYS), **arrays)
+            # a transient disk blip must not cost a rotation slot: the
+            # real writes retry with deterministic backoff (ckpt_io
+            # rehearses exactly this); ckpt_write below stays OUTSIDE
+            # the retry — it simulates a crash, not a blip
+            retry_with_backoff(_tensors, name='ckpt.write')
             # torn-write rehearsal point: tensors on disk, marker not yet
             _faults.maybe_fail('ckpt_write')
-            with open(os.path.join(tmp, _META), 'w') as f:
-                json.dump(meta, f)
+            retry_with_backoff(
+                lambda: _write_json_atomic(os.path.join(tmp, _META), meta),
+                name='ckpt.meta')
             with open(os.path.join(tmp, _SUCCESS), 'w') as f:
                 f.write('ok')
-            if os.path.isdir(final_dir):
-                shutil.rmtree(final_dir)
-            os.rename(tmp, final_dir)
+            with self.dir_lock():
+                if os.path.isdir(final_dir):
+                    shutil.rmtree(final_dir)
+                os.rename(tmp, final_dir)
+                self._rotate()
         except _faults.InjectedFault:
             # an injected fault simulates a CRASH mid-write: a crashed
             # process runs no cleanup, so the torn temp dir stays on disk
@@ -236,7 +456,6 @@ class Checkpointer(object):
         except Exception:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
-        self._rotate()
         if obs_on:
             t1 = time.perf_counter()
             _obs.metrics.counter('ckpt.saves').inc()
@@ -246,6 +465,144 @@ class Checkpointer(object):
             _obs.tracing.add_span('ckpt.write', t0, t1, cat='ckpt',
                                   args={'serial': serial,
                                         'step': meta.get('step_id')})
+
+    # ---------------------------------------------------- sharded commit
+    def _write_sharded(self, serial, final_dir, arrays, meta, specs,
+                       mesh_info):
+        """Land THIS host's shard in the serial's .parts staging dir,
+        then try to finalize (the last roster member to land wins)."""
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else None
+        cfg = self.config
+        if os.path.exists(os.path.join(final_dir, _SUCCESS)):
+            return   # already committed (a signal flush replayed a step)
+        parts = final_dir + _PARTS
+        os.makedirs(parts, exist_ok=True)
+        h = cfg.host_id
+        fname = _SHARD_NPZ % h
+        fpath = os.path.join(parts, fname)
+
+        def _tensors():
+            _faults.maybe_fail('ckpt_io')
+            tmpf = '%s.tmp%d' % (fpath, os.getpid())
+            with open(tmpf, 'wb') as f:
+                np.savez(f, **arrays)
+            os.replace(tmpf, fpath)
+        retry_with_backoff(_tensors, name='ckpt.shard_write')
+        digest, nbytes = _sha256_file(fpath)
+        # torn-write rehearsal: shard tensors on disk, sidecar not yet —
+        # the serial can never finalize and must be swept as a unit
+        _faults.maybe_fail('ckpt_write')
+        if _faults.fire('host_desync', int(meta.get('step_id', 0))):
+            # a drifted host: its sidecar claims a far-future step; the
+            # finalize guard must refuse to commit the mixed serial
+            meta = dict(meta,
+                        step_id=int(meta.get('step_id', 0)) + _DESYNC_SKEW)
+        sidecar = {'format': _FORMAT, 'serial': serial, 'host': h,
+                   'host_count': cfg.host_count, 'file': fname,
+                   'sha256': digest, 'bytes': nbytes, 'arrays': specs,
+                   'meta': meta, 'mesh': mesh_info or {}}
+        retry_with_backoff(
+            lambda: _write_json_atomic(
+                os.path.join(parts, _SHARD_META % h), sidecar),
+            name='ckpt.shard_meta')
+        if obs_on:
+            _obs.metrics.counter('ckpt.shard_writes').inc()
+            _obs.metrics.counter('ckpt.shard_bytes_written').inc(nbytes)
+            _obs.tracing.add_span('ckpt.shard_write', t0,
+                                  time.perf_counter(), cat='ckpt',
+                                  args={'serial': serial, 'host': h,
+                                        'bytes': nbytes})
+        self._try_finalize(serial, final_dir, parts)
+
+    def _try_finalize(self, serial, final_dir, parts):
+        """Commit the serial if the whole roster has landed: verify every
+        sidecar agrees on (roster, step), assemble MANIFEST.json, mark
+        _SUCCESS, rename .parts into place — all under ckpt.lock, so
+        concurrent finalizers and sweepers serialize.  Returns the final
+        dir, or None while the roster is still incomplete."""
+        cfg = self.config
+        H = cfg.host_count
+        with self.dir_lock():
+            if os.path.exists(os.path.join(final_dir, _SUCCESS)):
+                return final_dir   # a peer finalized first
+            sidecars = []
+            for hh in range(H):
+                try:
+                    with open(os.path.join(parts, _SHARD_META % hh)) as f:
+                        m = json.load(f)
+                except (OSError, ValueError):
+                    return None   # roster incomplete: a peer still writing
+                if m.get('format') != _FORMAT or \
+                        int(m.get('host_count', -1)) != H:
+                    return None   # sidecar from a different roster era
+                sidecars.append(m)
+            steps = sorted({int(m['meta'].get('step_id', -1))
+                            for m in sidecars})
+            if len(steps) > 1:
+                # the roster disagrees on WHAT STEP this serial is —
+                # committing would mix optimizer states across steps;
+                # the torn serial is dropped as a unit
+                _obs.metrics.counter('ckpt.desync_dropped').inc()
+                _obs.metrics.counter('health.desyncs').inc()
+                _flight.record('ckpt.desync', serial=serial, steps=steps)
+                _flight.maybe_dump('ckpt_desync')
+                shutil.rmtree(parts, ignore_errors=True)
+                return None
+            manifest = {
+                'format': _FORMAT, 'serial': serial,
+                'meta': sidecars[0]['meta'],
+                'mesh': sidecars[0]['mesh'],
+                'writers': list(range(H)),
+                'sharding': self._sharding_info(),
+                'files': {m['file']: {'host': m['host'],
+                                      'sha256': m['sha256'],
+                                      'bytes': m['bytes']}
+                          for m in sidecars},
+                'arrays': {},
+            }
+            for m in sidecars:
+                for n, spec in m['arrays'].items():
+                    g = manifest['arrays'].setdefault(
+                        n, {'shape': spec['shape'], 'dtype': spec['dtype'],
+                            'shards': []})
+                    shard = {'host': m['host'], 'file': m['file']}
+                    if 'start' in spec:
+                        shard['start'] = spec['start']
+                        shard['stop'] = spec['stop']
+                    g['shards'].append(shard)
+            # the committed dir is EXACTLY the manifest's contents: drop
+            # strays (tmp files, shards from a dead larger roster)
+            keep = set(manifest['files'])
+            keep.update(_SHARD_META % hh for hh in range(H))
+            for nm in os.listdir(parts):
+                if nm in keep or nm in (_MANIFEST, _SUCCESS):
+                    continue
+                p = os.path.join(parts, nm)
+                try:
+                    shutil.rmtree(p) if os.path.isdir(p) else os.unlink(p)
+                except OSError:
+                    pass
+            retry_with_backoff(
+                lambda: _write_json_atomic(
+                    os.path.join(parts, _MANIFEST), manifest),
+                name='ckpt.manifest')
+            with open(os.path.join(parts, _SUCCESS), 'w') as f:
+                f.write('ok')
+            if os.path.isdir(final_dir):
+                shutil.rmtree(final_dir)
+            os.rename(parts, final_dir)
+            self._rotate()
+        _obs.metrics.counter('ckpt.saves').inc()
+        _obs.metrics.counter('ckpt.shard_manifests').inc()
+        if _obs.enabled():
+            _obs.metrics.counter('ckpt.bytes_written').inc(
+                sum(rec['bytes'] for rec in manifest['files'].values()))
+            _obs.tracing.instant(
+                'ckpt.commit', cat='ckpt',
+                args={'serial': serial, 'hosts': H,
+                      'step': manifest['meta'].get('step_id')})
+        return final_dir
 
     def wait(self, timeout=None):
         """Block until every queued write has hit disk (or failed)."""
@@ -272,7 +629,7 @@ class Checkpointer(object):
             return []
         out = []
         for name in os.listdir(d):
-            if not name.startswith('checkpoint_'):
+            if not name.startswith('checkpoint_') or name.endswith(_PARTS):
                 continue
             try:
                 s = int(name.split('_')[1])
@@ -285,43 +642,133 @@ class Checkpointer(object):
 
     def _rotate(self):
         keep = self.config.max_num_checkpoints
-        serials = self._serials()
-        for s in serials[:-keep] if keep > 0 else []:
-            shutil.rmtree(self._dir_of(s), ignore_errors=True)
+        with self.dir_lock():
+            serials = self._serials()
+            for s in serials[:-keep] if keep > 0 else []:
+                shutil.rmtree(self._dir_of(s), ignore_errors=True)
 
     def _sweep_torn(self):
-        """Delete torn checkpoint dirs (no _SUCCESS) and stale temp dirs.
-        Runs from restore() — after wait(), none of OUR writes are in
-        flight, and a temp dir from a previous (killed) process is by
-        definition dead."""
+        """Delete torn checkpoint dirs (no _SUCCESS), stale temp dirs,
+        and dead .parts staging dirs.  Runs from restore() — after
+        wait(), none of OUR writes are in flight, and a temp dir from a
+        previous (killed) process is by definition dead.  A .parts dir
+        is swept as a UNIT when its serial is already committed or
+        superseded (<= the newest valid serial), or when it has gone
+        ``stale_parts_s`` without progress — a live lockstep roster
+        lands its shards within one step of each other."""
         d = self.config.checkpoint_dir
         if not os.path.isdir(d):
             return 0
-        dropped = 0
-        valid = set(self._serials())
-        for name in os.listdir(d):
-            path = os.path.join(d, name)
-            if name.startswith('.tmp_ckpt_'):
-                shutil.rmtree(path, ignore_errors=True)
-                dropped += 1
-            elif name.startswith('checkpoint_'):
-                try:
-                    s = int(name.split('_')[1])
-                except (IndexError, ValueError):
-                    continue
-                if s not in valid:
+        dropped = partial = 0
+        with self.dir_lock():
+            valid = set(self._serials())
+            newest = max(valid) if valid else None
+            for name in os.listdir(d):
+                path = os.path.join(d, name)
+                if name.startswith('.tmp_ckpt_'):
                     shutil.rmtree(path, ignore_errors=True)
                     dropped += 1
+                elif name.startswith('checkpoint_') and \
+                        name.endswith(_PARTS):
+                    try:
+                        s = int(name[len('checkpoint_'):-len(_PARTS)])
+                    except ValueError:
+                        continue
+                    stale = newest is not None and s <= newest
+                    if not stale and self.config.stale_parts_s is not None:
+                        try:
+                            age = time.time() - os.path.getmtime(path)
+                        except OSError:
+                            continue
+                        stale = age > self.config.stale_parts_s
+                    if stale:
+                        shutil.rmtree(path, ignore_errors=True)
+                        partial += 1
+                elif name.startswith('checkpoint_'):
+                    try:
+                        s = int(name.split('_')[1])
+                    except (IndexError, ValueError):
+                        continue
+                    if s not in valid:
+                        shutil.rmtree(path, ignore_errors=True)
+                        dropped += 1
         if dropped:
             _obs.metrics.counter('ckpt.torn_deleted').inc(dropped)
-        return dropped
+        if partial:
+            _obs.metrics.counter('ckpt.partial_swept').inc(partial)
+        return dropped + partial
 
     # ------------------------------------------------------------ restore
+    def _load_legacy(self, ckpt, keep):
+        with np.load(os.path.join(ckpt, _ARRAYS),
+                     allow_pickle=False) as data:
+            arrays = {n: data[n] for n in data.files
+                      if keep is None or n in keep}
+        with open(os.path.join(ckpt, _META)) as f:
+            meta = json.load(f)
+        return arrays, meta
+
+    def _load_sharded(self, ckpt, keep):
+        """Reassemble global arrays from a manifest checkpoint: verify
+        every shard file against its SHA-256 FIRST (a flipped bit in any
+        shard fails the whole serial), then fill each global array from
+        its shards' recorded slice bounds.  The manifest's mesh/roster
+        is compared with the CURRENT config — a mismatch is an elastic
+        restore and counts ``ckpt.reshards``."""
+        with open(os.path.join(ckpt, _MANIFEST)) as f:
+            man = json.load(f)
+        if man.get('format') != _FORMAT:
+            raise ValueError('unknown manifest format %r'
+                             % (man.get('format'),))
+        for fname, rec in man['files'].items():
+            digest, _ = _sha256_file(os.path.join(ckpt, fname))
+            if digest != rec['sha256']:
+                raise ValueError('checksum mismatch in %s' % fname)
+        wanted = {n: rec for n, rec in man['arrays'].items()
+                  if keep is None or n in keep}
+        by_file = {}
+        for n, rec in wanted.items():
+            for sh in rec['shards']:
+                by_file.setdefault(sh['file'], []).append((n, rec, sh))
+        arrays = {}
+        for fname, entries in by_file.items():
+            with np.load(os.path.join(ckpt, fname),
+                         allow_pickle=False) as data:
+                for n, rec, sh in entries:
+                    piece = data[n]
+                    if n not in arrays:
+                        # dtype from the data, not the manifest: extension
+                        # dtypes (bfloat16) round-trip through npz but not
+                        # through np.dtype(str)
+                        arrays[n] = np.empty(tuple(rec['shape']),
+                                             dtype=piece.dtype)
+                    if arrays[n].ndim == 0:
+                        arrays[n][()] = piece
+                    else:
+                        arrays[n][int(sh['start']):int(sh['stop'])] = piece
+        meta = dict(man['meta'])
+        cur_mesh = self._mesh_info()
+        cur_writers = list(range(self.config.host_count))
+        if man.get('mesh') != cur_mesh or \
+                man.get('writers') != cur_writers:
+            _obs.metrics.counter('ckpt.reshards').inc()
+            if _obs.enabled():
+                _obs.tracing.instant(
+                    'ckpt.reshard', cat='ckpt',
+                    args={'from_mesh': man.get('mesh'),
+                          'to_mesh': cur_mesh,
+                          'from_hosts': len(man.get('writers') or []),
+                          'to_hosts': self.config.host_count})
+        return arrays, meta
+
     def restore(self):
         """Load the newest COMPLETE checkpoint (torn ones — no SUCCESS
-        marker — are deleted), put every array back in the scope, re-arm
-        the executor's RNG/run counters, and return the meta dict (None
-        if nothing to restore)."""
+        marker — are deleted, partial multi-host serials swept as a
+        unit), put every array back in the scope, re-arm the executor's
+        RNG/run counters, and return the meta dict (None if nothing to
+        restore).  Both formats restore onto any config: a manifest
+        checkpoint is reassembled and re-sliced for the current mesh/
+        roster (elastic restore), a legacy one loads whole."""
         try:
             self.wait()
         except RuntimeError:
@@ -335,12 +782,10 @@ class Checkpointer(object):
         for s in reversed(self._serials()):
             ckpt = self._dir_of(s)
             try:
-                with np.load(os.path.join(ckpt, _ARRAYS),
-                             allow_pickle=False) as data:
-                    arrays = {n: data[n] for n in data.files
-                              if keep is None or n in keep}
-                with open(os.path.join(ckpt, _META)) as f:
-                    meta = json.load(f)
+                if os.path.exists(os.path.join(ckpt, _MANIFEST)):
+                    arrays, meta = self._load_sharded(ckpt, keep)
+                else:
+                    arrays, meta = self._load_legacy(ckpt, keep)
             except Exception:
                 # corrupt beyond the marker: fall back to the previous one
                 _obs.metrics.counter('ckpt.corrupt_skipped').inc()

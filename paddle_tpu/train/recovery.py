@@ -33,6 +33,13 @@ class DivergenceError(RuntimeError):
     arrive as the executor's own RuntimeError)."""
 
 
+def _is_device_loss(exc):
+    # lazy: train/ must not drag the parallel package (jax mesh imports)
+    # in at module load just to classify an exception
+    from ..parallel.health import DeviceLossError
+    return isinstance(exc, DeviceLossError)
+
+
 def is_divergence(exc):
     """Is this exception a numeric divergence the policy may absorb?
     Anything else (shape errors, OOM, bugs) must propagate untouched."""
@@ -97,6 +104,17 @@ class RecoveryPolicy(object):
             self._consecutive = 0
             return out
         except Exception as e:  # noqa: BLE001 - filtered right below
+            if _is_device_loss(e):
+                # a pod fault, not a divergence: the mesh this run was
+                # compiled for no longer exists, so skipping-and-continuing
+                # is meaningless.  Roll the scope back to the last good
+                # manifest (so the NEXT incarnation restores clean state
+                # even if this process's shards were mid-write) and
+                # re-raise — the supervisor restarts on a smaller mesh
+                # (parallel/health.py RESTART_EXIT_CODE protocol).
+                _obs.metrics.counter('recovery.device_loss').inc()
+                self.rollback(reason=repr(e)[:200])
+                raise
             if not is_divergence(e):
                 raise
             self._consecutive += 1

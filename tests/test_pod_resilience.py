@@ -1,0 +1,439 @@
+"""Pod-scale resilience (train/checkpoint.py sharded mode,
+parallel/health.py, train/recovery.py): sharded manifest checkpoints with
+an all-hosts-or-nothing commit, elastic restore onto a different roster,
+the device-health watchdog, device-loss recovery, and the multi-process
+kill-and-reshard acceptance contract."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.parallel.health import (DeviceLossError, HealthConfig,
+                                        HealthMonitor, HostDesyncError)
+from paddle_tpu.testing import faults
+from paddle_tpu.train import CheckpointConfig, Checkpointer, RecoveryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _build_model(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[4], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, 8, act='relu')
+            h = fluid.layers.dropout(h, 0.3)
+            logits = fluid.layers.fc(h, 3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    main.set_amp(True)
+    return main, startup, loss
+
+
+def _feed_at(i):
+    rng = np.random.RandomState(100 + i)
+    return {'x': rng.rand(4, 4).astype('float32'),
+            'lbl': rng.randint(0, 3, (4, 1)).astype('int64')}
+
+
+def _sharded_cfg(path, **kw):
+    kw.setdefault('step_interval', 1)
+    kw.setdefault('sharded', True)
+    return CheckpointConfig(str(path), **kw)
+
+
+def _trained_scope(steps=2):
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(steps):
+            exe.run(main, feed=_feed_at(i), fetch_list=[loss])
+    return main, loss, exe, scope
+
+
+# ------------------------------------------------- sharded manifest format
+
+def test_sharded_manifest_schema_and_roundtrip(tmp_path):
+    main, loss, exe, scope = _trained_scope()
+    ck = Checkpointer(_sharded_cfg(tmp_path), exe, main, scope=scope)
+    ck.save(0, 1)
+    ck.wait()
+    ckpt = tmp_path / 'checkpoint_2'   # serial is step-derived: step + 1
+    for fname in ('_SUCCESS', 'MANIFEST.json', 'arrays_0.npz',
+                  'shard_0.json'):
+        assert (ckpt / fname).exists(), fname
+    man = json.loads((ckpt / 'MANIFEST.json').read_text())
+    assert man['format'] == 'ptckpt-sharded-1'
+    assert man['writers'] == [0]
+    assert man['meta']['step_id'] == 1 and man['meta']['rng_state']
+    assert set(man['files']) == {'arrays_0.npz'}
+    rec = man['files']['arrays_0.npz']
+    assert rec['host'] == 0 and len(rec['sha256']) == 64 and rec['bytes'] > 0
+    for n, arr in man['arrays'].items():
+        assert 'shape' in arr and 'dtype' in arr and arr['shards'], n
+    w = np.asarray(scope.get('fc_0.w_0'))
+    m1 = np.asarray(scope.get('fc_0.w_0_moment1_0'))
+
+    main2, _, _ = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck2 = Checkpointer(_sharded_cfg(tmp_path), exe2, main2, scope=scope2)
+    meta = ck2.restore()
+    assert meta['step_id'] == 1
+    np.testing.assert_array_equal(np.asarray(scope2.get('fc_0.w_0')), w)
+    np.testing.assert_array_equal(
+        np.asarray(scope2.get('fc_0.w_0_moment1_0')), m1)
+
+
+def test_two_host_commit_is_all_or_nothing_and_elastic(tmp_path):
+    """One host's shard alone must never become a restorable checkpoint;
+    the full roster commits, and a 1-host restore reassembles the global
+    arrays bitwise (counting the reshard)."""
+    main, loss, exe, scope = _trained_scope()
+    ck0 = Checkpointer(_sharded_cfg(tmp_path, host_id=0, host_count=2),
+                       exe, main, scope=scope)
+    ck1 = Checkpointer(_sharded_cfg(tmp_path, host_id=1, host_count=2),
+                       exe, main, scope=scope)
+    ck0.save(0, 0)
+    ck0.wait()
+    final = tmp_path / 'checkpoint_1'
+    assert not final.exists(), 'half a roster must not commit'
+    assert (tmp_path / 'checkpoint_1.parts' / 'arrays_0.npz').exists()
+    ck1.save(0, 0)
+    ck1.wait()
+    assert (final / '_SUCCESS').exists()
+    assert not (tmp_path / 'checkpoint_1.parts').exists()
+    man = json.loads((final / 'MANIFEST.json').read_text())
+    assert man['writers'] == [0, 1]
+    assert set(man['files']) == {'arrays_0.npz', 'arrays_1.npz'}
+    w = np.asarray(scope.get('fc_0.w_0'))
+    m1 = np.asarray(scope.get('fc_0.w_0_moment1_0'))
+
+    # elastic restore onto a 1-host roster: global arrays reassembled
+    r0 = obs.counters().get('ckpt.reshards') or 0
+    main2, _, _ = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(_sharded_cfg(tmp_path), exe2, main2, scope=scope2)
+    assert ck.restore()['step_id'] == 0
+    np.testing.assert_array_equal(np.asarray(scope2.get('fc_0.w_0')), w)
+    np.testing.assert_array_equal(
+        np.asarray(scope2.get('fc_0.w_0_moment1_0')), m1)
+    assert (obs.counters().get('ckpt.reshards') or 0) == r0 + 1
+
+    # a same-roster restore is NOT a reshard
+    main3, _, _ = _build_model()
+    exe3, scope3 = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(_sharded_cfg(tmp_path, host_id=0, host_count=2),
+                      exe3, main3, scope=scope3)
+    assert ck.restore()['step_id'] == 0
+    assert (obs.counters().get('ckpt.reshards') or 0) == r0 + 1
+
+
+def test_partial_roster_is_swept_as_a_unit(tmp_path):
+    """A .parts staging dir whose writer died mid-roster is swept whole —
+    restore never sees half a pod checkpoint."""
+    main, loss, exe, scope = _trained_scope()
+    ck0 = Checkpointer(_sharded_cfg(tmp_path, host_id=0, host_count=2),
+                       exe, main, scope=scope)
+    ck1 = Checkpointer(_sharded_cfg(tmp_path, host_id=1, host_count=2),
+                       exe, main, scope=scope)
+    for ck in (ck0, ck1):
+        ck.save(0, 0)
+        ck.wait()
+    ck0.save(0, 1)            # host 1 "dies" before contributing
+    ck0.wait()
+    assert (tmp_path / 'checkpoint_2.parts').exists()
+
+    p0 = obs.counters().get('ckpt.partial_swept') or 0
+    main2, _, _ = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(_sharded_cfg(tmp_path, stale_parts_s=0.0),
+                      exe2, main2, scope=scope2)
+    meta = ck.restore()
+    assert meta['step_id'] == 0, 'must fall back to the last FULL serial'
+    assert not (tmp_path / 'checkpoint_2.parts').exists()
+    assert (obs.counters().get('ckpt.partial_swept') or 0) == p0 + 1
+
+
+def test_host_desync_fault_drops_the_mixed_serial(tmp_path):
+    """The host_desync fault skews one sidecar's step; the finalize guard
+    must refuse to commit a serial whose roster disagrees on the step."""
+    main, loss, exe, scope = _trained_scope()
+    ck0 = Checkpointer(_sharded_cfg(tmp_path, host_id=0, host_count=2),
+                       exe, main, scope=scope)
+    ck1 = Checkpointer(_sharded_cfg(tmp_path, host_id=1, host_count=2),
+                       exe, main, scope=scope)
+    for ck in (ck0, ck1):
+        ck.save(0, 0)
+        ck.wait()
+    d0 = obs.counters().get('ckpt.desync_dropped') or 0
+    faults.configure('host_desync:at=1')   # step-indexed: fires at step 1
+    ck0.save(0, 1)
+    ck0.wait()
+    ck1.save(0, 1)
+    ck1.wait()
+    assert not (tmp_path / 'checkpoint_2').exists()
+    assert not (tmp_path / 'checkpoint_2.parts').exists()
+    c = obs.counters()
+    assert c.get('ckpt.desync_dropped') == d0 + 1
+    assert (c.get('health.desyncs') or 0) >= 1
+    assert (c.get('faults.injected.host_desync') or 0) >= 1
+
+    main2, _, _ = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(_sharded_cfg(tmp_path), exe2, main2, scope=scope2)
+    assert ck.restore()['step_id'] == 0
+
+
+def test_manifest_records_parallel_executor_mesh(tmp_path):
+    from paddle_tpu.parallel.parallel_executor import ParallelExecutor
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                              scope=scope)
+        rng = np.random.RandomState(0)   # batch divisible by the 8-dev mesh
+        pe.run([loss.name],
+               feed={'x': rng.rand(8, 4).astype('float32'),
+                     'lbl': rng.randint(0, 3, (8, 1)).astype('int64')})
+        ck = Checkpointer(_sharded_cfg(tmp_path), pe, main, scope=scope)
+        ck.save(0, 0)
+        ck.wait()
+    man = json.loads(
+        (tmp_path / 'checkpoint_1' / 'MANIFEST.json').read_text())
+    assert man['mesh']['axes'], 'mesh axes missing from the manifest'
+    assert int(np.prod(man['mesh']['shape'])) == pe.device_count
+    assert man['meta']['rng_state'], 'PE must delegate rng_state()'
+
+
+# --------------------------------------------------- device-health watchdog
+
+def _monitors(tmp_path, now, timeout_s=1.0, desync_steps=100):
+    mk = lambda h: HealthMonitor(  # noqa: E731 - local factory
+        HealthConfig(str(tmp_path), host_id=h, host_count=2,
+                     timeout_s=timeout_s, desync_steps=desync_steps),
+        time_fn=lambda: now[0])
+    return mk(0), mk(1)
+
+
+def test_health_staleness_trips_and_is_sticky(tmp_path):
+    now = [0.0]
+    h0, h1 = _monitors(tmp_path, now)
+    assert h1.beat(0) and h0.beat(0)
+    h0.check(0)                       # fresh roster: healthy
+    now[0] = 5.0
+    h0.beat(1)
+    t0 = obs.counters().get('health.trips') or 0
+    with pytest.raises(DeviceLossError, match='host 1 lost'):
+        h0.check(1)
+    with pytest.raises(DeviceLossError):
+        h0.check(1)                   # sticky: same verdict forever
+    c = obs.counters()
+    assert c.get('health.trips') == t0 + 1
+    assert (c.get('health.lost_hosts') or 0) >= 1
+
+
+def test_health_tolerates_not_yet_joined_and_done_peers(tmp_path):
+    now = [0.0]
+    h0, h1 = _monitors(tmp_path, now)
+    h0.beat(0)
+    h0.check(0)                       # peer never beat: still joining
+    h1.beat(3)
+    h1.mark_done()
+    now[0] = 100.0
+    h0.beat(4)
+    h0.check(4)                       # done peer is healthy forever
+
+
+def test_health_desync_trips(tmp_path):
+    now = [0.0]
+    h0, h1 = _monitors(tmp_path, now, desync_steps=100)
+    h1.beat(1000)
+    h0.beat(0)
+    with pytest.raises(HostDesyncError, match='desynced'):
+        h0.check(0)
+    assert (obs.counters().get('health.desyncs') or 0) >= 1
+
+
+def test_health_disappeared_heartbeat_trips(tmp_path):
+    now = [0.0]
+    h0, h1 = _monitors(tmp_path, now)
+    h1.beat(0)
+    h0.beat(0)
+    h0.check(0)
+    os.unlink(h0.path_of(1))
+    with pytest.raises(DeviceLossError, match='disappeared'):
+        h0.check(0)
+
+
+def test_device_loss_fault_silences_beats(tmp_path):
+    """The injected loss is a SILENT death: beat() refuses from the armed
+    step on, and the peer detects it purely from staleness."""
+    faults.configure('device_loss:at=2')
+    now = [0.0]
+    h0, h1 = _monitors(tmp_path, now)
+    assert h1.beat(1)
+    assert not h1.beat(2)             # fault: goes quiet
+    assert not h1.beat(3)             # ...and stays quiet
+    h0.beat(2)
+    now[0] = 5.0
+    h0.beat(3)
+    with pytest.raises(DeviceLossError):
+        h0.check(3)
+    assert (obs.counters().get('faults.injected.device_loss') or 0) >= 1
+
+
+def test_host_desync_fault_skews_heartbeat(tmp_path):
+    faults.configure('host_desync:at=1')
+    now = [0.0]
+    h0, h1 = _monitors(tmp_path, now, desync_steps=100)
+    h1.beat(1)                        # fault: records a far-future step
+    h0.beat(1)
+    with pytest.raises(HostDesyncError):
+        h0.check(1)
+
+
+# ------------------------------------------------- recovery integration
+
+def test_recovery_device_loss_rolls_back_and_reraises(tmp_path):
+    """Device loss is a pod fault, not a divergence: RecoveryPolicy must
+    roll back to the last good manifest and RE-RAISE (the supervisor
+    restarts the process), never skip-and-continue."""
+    main, loss, exe, scope = _trained_scope()
+    ck = Checkpointer(_sharded_cfg(tmp_path), exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        ck.save(0, 0)
+        ck.wait()
+        w0 = np.asarray(scope.get('fc_0.w_0'))
+        scope.set('fc_0.w_0', w0 + 1.0)   # poisoned in-flight state
+        pol = RecoveryPolicy(ck, max_retries=3)
+        d0 = obs.counters().get('recovery.device_loss') or 0
+        with pytest.raises(DeviceLossError):
+            pol.run(lambda: (_ for _ in ()).throw(
+                DeviceLossError('host 1 lost')))
+        np.testing.assert_array_equal(np.asarray(scope.get('fc_0.w_0')), w0)
+    c = obs.counters()
+    assert c.get('recovery.device_loss') == d0 + 1
+    assert (c.get('recovery.rollbacks') or 0) >= 1
+
+
+# --------------------------------- kill-and-reshard acceptance (E2E)
+
+_POD_SCRIPT = r"""
+import json, os, signal, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ.setdefault('PT_CACHE', '0')
+sys.path.insert(0, sys.argv[1])
+ckpt_dir = sys.argv[2]
+host, hosts = int(sys.argv[3]), int(sys.argv[4])
+total, kill_at = int(sys.argv[5]), int(sys.argv[6])
+import numpy as np
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.train import CheckpointConfig, Checkpointer
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = 11
+with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard():
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, 8, act='relu')
+        h = fluid.layers.dropout(h, 0.3)
+        logits = fluid.layers.fc(h, 3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+main.set_amp(True)
+
+def feed_at(i):
+    rng = np.random.RandomState(100 + i)
+    return {'x': rng.rand(4, 4).astype('float32'),
+            'lbl': rng.randint(0, 3, (4, 1)).astype('int64')}
+
+exe, scope = fluid.Executor(), fluid.Scope()
+ck = Checkpointer(CheckpointConfig(ckpt_dir, step_interval=1,
+                                   max_num_checkpoints=4, sharded=True,
+                                   host_id=host, host_count=hosts,
+                                   stale_parts_s=0.0),
+                  exe, main, scope=scope)
+meta = ck.restore()
+start = meta['step_id'] + 1 if meta else 0
+losses = []
+with fluid.scope_guard(scope):
+    if meta is None:
+        exe.run(startup)
+    for i in range(start, total):
+        l, = exe.run(main, feed=feed_at(i), fetch_list=[loss])
+        losses.append(float(np.asarray(l).ravel()[0]))
+        ck.save(0, i)
+        if i == kill_at:
+            ck.wait()   # this host's shard is durable; now die hard
+            os.kill(os.getpid(), signal.SIGKILL)
+ck.wait()
+print(json.dumps({'start': start, 'losses': losses,
+                  'reshards': obs.counters().get('ckpt.reshards') or 0}))
+"""
+
+
+def _pod_proc(ckpt_dir, host, hosts, total=8, kill_at=-1):
+    env = {k: v for k, v in os.environ.items() if k != 'PT_FAULT'}
+    return subprocess.Popen(
+        [sys.executable, '-c', _POD_SCRIPT, REPO, str(ckpt_dir), str(host),
+         str(hosts), str(total), str(kill_at)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+
+
+def test_sharded_kill_and_elastic_resume_is_bitwise(tmp_path):
+    """The acceptance contract: two lockstep hosts write a 2x-sharded
+    checkpoint stream, both are SIGKILLed mid-run, and a SINGLE-host
+    process elastically restores the newest manifest and finishes — with
+    losses bitwise equal to an uninterrupted single-host run."""
+    ref_p = _pod_proc(tmp_path / 'full', 0, 1)
+    out, err = ref_p.communicate(timeout=240)
+    assert ref_p.returncode == 0, err
+    ref = json.loads(out.strip().splitlines()[-1])
+    assert ref['start'] == 0 and len(ref['losses']) == 8
+
+    # the pod: both hosts die hard right after step 4's shards are durable
+    workers = [_pod_proc(tmp_path / 'pod', h, 2, kill_at=4)
+               for h in range(2)]
+    for p in workers:
+        p.communicate(timeout=240)
+        assert p.returncode == -signal.SIGKILL, p.returncode
+
+    # a committed manifest for the kill step exists (serial = step + 1)
+    man_path = tmp_path / 'pod' / 'checkpoint_5' / 'MANIFEST.json'
+    assert man_path.exists(), os.listdir(tmp_path / 'pod')
+    assert json.loads(man_path.read_text())['writers'] == [0, 1]
+
+    # elastic resume on ONE host: reassembles the 2-shard manifest
+    res_p = _pod_proc(tmp_path / 'pod', 0, 1)
+    out, err = res_p.communicate(timeout=240)
+    assert res_p.returncode == 0, err
+    res = json.loads(out.strip().splitlines()[-1])
+    assert res['start'] == 5, res
+    assert res['reshards'] >= 1, 'the 2->1 restore must count a reshard'
+    assert res['losses'] == ref['losses'][5:], \
+        'elastic resume diverged from the uninterrupted run'
+    # no orphaned staging debris after the sweep
+    leftovers = [d for d in os.listdir(tmp_path / 'pod')
+                 if d.startswith('.tmp_ckpt_') or d.endswith('.parts')]
+    assert not leftovers, leftovers

@@ -159,6 +159,131 @@ def test_torn_checkpoint_scan_restores_previous_valid(tmp_path):
     assert (obs.counters().get('ckpt.torn_deleted') or 0) >= 1
 
 
+# ------------------------------------------------- retry-routed disk I/O
+
+def test_ckpt_io_transient_blip_absorbed_by_retry(tmp_path):
+    """A one-shot ckpt_io OSError is a blip, not a torn write: the
+    retried writer absorbs it and the checkpoint still lands."""
+    faults.configure('ckpt_io:at=1')
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    c0 = obs.counters()
+    w0, r0 = c0.get('ckpt.write_failures') or 0, c0.get('retry.attempts') or 0
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(0, 0)
+        ck.wait()
+    c = obs.counters()
+    assert (c.get('ckpt.write_failures') or 0) == w0, 'blip must be absorbed'
+    assert (c.get('retry.attempts') or 0) > r0
+    assert (c.get('retry.attempts.ckpt.write') or 0) >= 1
+    meta = Checkpointer(CheckpointConfig(str(tmp_path)), exe, main,
+                        scope=scope).restore()
+    assert meta['step_id'] == 0
+
+
+def test_ckpt_io_exhausted_retry_budget_fails_the_write(tmp_path):
+    """A persistent disk failure burns the whole backoff budget, then
+    surfaces exactly like any other write failure: counted + warned."""
+    faults.configure('ckpt_io:at=1:times=99')
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    g0 = obs.counters().get('retry.giveups') or 0
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(0, 0)
+        with pytest.warns(UserWarning, match='checkpoint write failed'):
+            ck.wait()
+    c = obs.counters()
+    assert (c.get('retry.giveups') or 0) > g0
+    assert (c.get('ckpt.write_failures') or 0) >= 1
+
+
+# --------------------------------------------- ckpt.lock (two processes)
+
+_LOCK_CHILD = r"""
+import fcntl, os, sys
+fd = os.open(sys.argv[1], os.O_CREAT | os.O_RDWR, 0o644)
+fcntl.flock(fd, fcntl.LOCK_EX)
+print('locked', flush=True)
+sys.stdin.readline()
+fcntl.flock(fd, fcntl.LOCK_UN)
+print('released', flush=True)
+"""
+
+
+def test_ckpt_lock_excludes_a_second_process(tmp_path):
+    """The satellite contract: two Checkpointers sharing one directory
+    cannot interleave rotation sweeps — a second PROCESS holding
+    ckpt.lock blocks dir_lock() until it releases."""
+    child = subprocess.Popen(
+        [sys.executable, '-c', _LOCK_CHILD, str(tmp_path / 'ckpt.lock')],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        assert child.stdout.readline().strip() == 'locked'
+        main, startup, loss = _build_model()
+        exe, scope = fluid.Executor(), fluid.Scope()
+        ck = Checkpointer(CheckpointConfig(str(tmp_path),
+                                           lock_timeout_s=0.4),
+                          exe, main, scope=scope)
+        with pytest.raises(RuntimeError, match='checkpoint lock'):
+            with ck.dir_lock():
+                pass
+        child.stdin.write('\n')
+        child.stdin.flush()
+        assert child.stdout.readline().strip() == 'released'
+        child.wait(timeout=30)
+        with ck.dir_lock():
+            pass   # free again once the peer released
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+
+# -------------------------------------------- manifest integrity (sharded)
+
+def test_corrupt_shard_and_manifest_fall_back_to_previous_serial(tmp_path):
+    """Flip one byte in a shard payload and one in a MANIFEST.json: both
+    serials must be skipped (checksum / parse failure), the previous
+    clean serial restored, and every skip counted."""
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1,
+                                       sharded=True),
+                      exe, main, scope=scope)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=_feed_at(0), fetch_list=[loss])
+        ck.save(0, 0)
+        ck.wait()
+        w0 = np.asarray(scope.get('fc_0.w_0'))
+        for i in (1, 2):
+            exe.run(main, feed=_feed_at(i), fetch_list=[loss])
+            ck.save(0, i)
+            ck.wait()
+
+    def flip(path):
+        blob = bytearray(path.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF
+        path.write_bytes(bytes(blob))
+
+    flip(tmp_path / 'checkpoint_3' / 'arrays_0.npz')      # newest: payload
+    flip(tmp_path / 'checkpoint_2' / 'MANIFEST.json')     # next: manifest
+    c0 = obs.counters().get('ckpt.corrupt_skipped') or 0
+    main2, startup2, loss2 = _build_model()
+    exe2, scope2 = fluid.Executor(), fluid.Scope()
+    ck2 = Checkpointer(CheckpointConfig(str(tmp_path), sharded=True),
+                       exe2, main2, scope=scope2)
+    meta = ck2.restore()
+    assert meta['step_id'] == 0, 'must land on the last CLEAN serial'
+    np.testing.assert_array_equal(np.asarray(scope2.get('fc_0.w_0')), w0)
+    assert (obs.counters().get('ckpt.corrupt_skipped') or 0) == c0 + 2
+
+
 # --------------------------------------------------------- recovery policy
 
 def test_recovery_rolls_back_and_skips_nan_step(tmp_path):

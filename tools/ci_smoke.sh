@@ -143,6 +143,27 @@ if [ "$resume_rc" -ne 0 ]; then
 fi
 rm -rf "$soak_dir"
 
+echo "== ci_smoke: pod soak (sharded ckpt, kill-and-resume, reshard) =="
+# pod-resilience gate (docs/robustness.md): two sharded-checkpoint
+# trainers over one directory; wave 1 SIGKILLs a worker mid-run (the
+# survivor must exit RESTART_EXIT_CODE via the health watchdog), wave 2
+# arms the device_loss fault site (a worker goes silent and wedges; the
+# peer must trip, roll back to the last good manifest, and request a
+# restart; the supervisor reaps exactly the wedged host), wave 3
+# restarts on the SMALLER roster and must elastically reshard
+# (--expect-resume --expect-reshard) and finish with losses bitwise
+# equal to an uninterrupted single-host run.  Zero orphaned tmp/.parts
+# dirs and >= 1 health-trip flight dump are asserted by the tool.
+pod_dir=$(mktemp -d /tmp/pt_pod.XXXXXX)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 \
+    python tools/pod_soak.py --workers 2 --steps 30 --dir "$pod_dir" \
+    --expect-resume --expect-reshard
+pod_rc=$?
+if [ "$pod_rc" -ne 0 ]; then
+    echo "ci_smoke: pod soak FAILED (rc=$pod_rc)"
+fi
+rm -rf "$pod_dir"
+
 echo "== ci_smoke: serving soak (continuous batching under chaos) =="
 # serving gate (docs/serving.md): serve_soak drives a real
 # Predictor-backed ServingEngine with closed+open-loop traffic while
@@ -311,4 +332,4 @@ fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$soak_rc" -eq 0 ] && \
-    [ "$resume_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ]
+    [ "$resume_rc" -eq 0 ] && [ "$pod_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ]
