@@ -36,9 +36,9 @@ from .. import observability as _obs
 from ..testing import faults as _faults
 from .retry import retry_with_backoff
 
-__all__ = ['launch_fingerprint', 'program_fingerprint', 'ExecutableLRU',
-           'DiskCache', 'disk_cache', 'cache_dir', 'disk_enabled',
-           'ensure_xla_cache_backstop']
+__all__ = ['launch_fingerprint', 'callable_fingerprint',
+           'program_fingerprint', 'ExecutableLRU', 'DiskCache', 'disk_cache',
+           'cache_dir', 'disk_enabled', 'ensure_xla_cache_backstop']
 
 # bump when the on-disk payload layout changes: old entries become misses
 CACHE_FORMAT = 1
@@ -120,6 +120,24 @@ def launch_fingerprint(program, feed_specs, fetch_names, steps, check_nan,
         'mesh': _mesh_blob(mesh),
         'env': _environment_blob(),
         'extra': extra,
+    }
+    canon = json.dumps(blob, sort_keys=True, default=str)
+    return hashlib.sha256(canon.encode()).hexdigest()
+
+
+def callable_fingerprint(kind, spec, param_specs=None):
+    """Cache key for AOT executables that are NOT program launches — the
+    streaming decode loop, prefill chunks, and similar hand-built jitted
+    callables.  ``kind`` namespaces the producer; ``spec`` is any
+    JSON-able blob that pins the callable's structure (model config,
+    cache geometry, window size, mesh layout); ``param_specs`` follows
+    the launch_fingerprint convention {name: (shape_tuple, dtype_str)}."""
+    blob = {
+        'kind': str(kind),
+        'spec': spec,
+        'params': {n: [list(s), d] for n, (s, d) in
+                   sorted((param_specs or {}).items())},
+        'env': _environment_blob(),
     }
     canon = json.dumps(blob, sort_keys=True, default=str)
     return hashlib.sha256(canon.encode()).hexdigest()

@@ -50,6 +50,7 @@ __all__ = [
     'sequence_erase',
     'warpctc', 'ctc_greedy_decoder', 'edit_distance', 'chunk_eval',
     'flash_attention', 'ring_attention', 'rms_norm', 'rope',
+    'sample_tokens',
     'linear_chain_crf', 'crf_decoding', 'one_hot', 'group_norm',
     'teacher_student_sigmoid_loss', 'roi_pool', 'roi_align', 'psroi_pool',
     'conv_shift', 'tree_conv', 'beam_search', 'beam_search_decode',
@@ -1766,6 +1767,23 @@ def rope(input, theta=10000.0, positions=None, name=None):
         ins['Positions'] = positions
     helper.append_op(type='rope', inputs=ins, outputs={'Out': out},
                      attrs={'theta': float(theta)})
+    return out
+
+
+def sample_tokens(logits, temperature=0.0, top_k=0, seed=0, name=None):
+    """Draw token ids over the last axis of `logits` (greedy when
+    temperature<=0; top_k>0 restricts the draw to the k highest logits).
+    New vs reference — `sampling_id` is the fluid-era analogue
+    (probabilities only, no temperature/top-k).  seed=0 draws from the
+    executor RNG stream, which the optimizer passes pin via the
+    `rng_stream` attr, so a PT_OPT-rewritten program samples the same
+    tokens as the raw one (see ops/sampling.py)."""
+    helper = LayerHelper('sample_tokens', name=name)
+    out = helper.create_variable_for_type_inference('int64')
+    helper.append_op(type='sample_tokens', inputs={'Logits': logits},
+                     outputs={'Out': out},
+                     attrs={'temperature': float(temperature),
+                            'top_k': int(top_k), 'seed': int(seed)})
     return out
 
 

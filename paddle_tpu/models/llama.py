@@ -302,3 +302,32 @@ def make_decoder(scope, config='tiny', temperature=0.0, **overrides):
         return np.asarray(generate(prompt, int(max_new), seed))
 
     return run
+
+
+# ------------------------------------------------- streaming generation
+
+def generation_weights(scope, config='tiny', **overrides):
+    """Pull the decode-side weight dict (host arrays, llama parameter
+    names) a trained llama program left in `scope` — the input format of
+    serving.generation.DecodeRuntime."""
+    from paddle_tpu.serving.generation.decode import weight_names
+    cfg = dict(CONFIGS[config] if isinstance(config, str) else config)
+    cfg.update(overrides)
+    return {n: np.asarray(scope.vars[n]) for n in weight_names(cfg)}
+
+
+def make_streaming_runtime(scope, config='tiny', slots=4, prefill_chunk=8,
+                           mesh=None, **overrides):
+    """Build a serving.generation.DecodeRuntime over a trained scope:
+    the streaming-decode counterpart of `make_decoder` (same weights,
+    but a slotted multi-request KV cache, fused K-token decode windows,
+    and chunked/ring prefill — the device half of GenerationEngine).
+
+        rt = llama.make_streaming_runtime(scope, 'tiny', slots=8)
+        engine = GenerationEngine(rt).start()
+    """
+    from paddle_tpu.serving.generation.decode import DecodeRuntime
+    cfg = dict(CONFIGS[config] if isinstance(config, str) else config)
+    cfg.update(overrides)
+    return DecodeRuntime(generation_weights(scope, cfg), cfg, slots=slots,
+                         prefill_chunk=prefill_chunk, mesh=mesh)

@@ -122,7 +122,13 @@ SCHEMA = {
         ('breaker_trips', ('int', 'serving.breaker_trips')),
         ('breaker_recoveries', ('int', 'serving.breaker_recoveries')),
         ('deadlocks', ('int', 'serving.deadlocks')),
-        ('counters', ('block_prefix', ('serving.', 'faults.'),
+        ('ttft_p50_ms', ('quantile', 'serving.ttft_ms', 0.50)),
+        ('ttft_p99_ms', ('quantile', 'serving.ttft_ms', 0.99)),
+        ('itl_p50_ms', ('quantile', 'serving.itl_ms', 0.50)),
+        ('itl_p99_ms', ('quantile', 'serving.itl_ms', 0.99)),
+        ('kv_slots_in_use', ('int', 'generation.kv_slots_in_use')),
+        ('counters', ('block_prefix', ('serving.', 'faults.',
+                                       'generation.'),
                       ('bucketer.bucket_count',))),
     ),
     'resilience': (

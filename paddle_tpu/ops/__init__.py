@@ -14,6 +14,7 @@ from . import metric  # noqa
 from . import sequence  # noqa
 from . import detection  # noqa
 from . import attention  # noqa
+from . import sampling  # noqa
 from . import ctc_crf  # noqa
 from . import int8  # noqa
 from . import fused  # noqa  (fused_elementwise from core/passes/fuse.py)

@@ -545,3 +545,52 @@ def ring_attention_op(ctx, ins, attrs):
         return {'Out': ring_attention(q, k, v, mesh, axis_name=axis,
                                       causal=causal, scale=scale)}
     return {'Out': flash_attention(q, k, v, causal=causal, scale=scale)}
+
+
+# --------------------------------------------------- KV-cache read path
+
+def cached_attention(q, kcache, vcache, qpos, scale=None):
+    """Attention of new-position queries against a KV cache row.
+
+    q: [B, H, Tq, D] — the Tq new positions (a prefill chunk, or Tq=1
+    for one decode step); kcache/vcache: [B, Hkv, Tmax, D] with the new
+    positions' K/V already written; qpos: [B, Tq] int32 ABSOLUTE
+    positions of the queries.  Masking is positional — key position
+    kpos is visible iff ``kpos <= qpos`` — so mid-prompt chunk offsets
+    and per-slot decode lengths share one rule, and garbage beyond a
+    row's true length is never attended (unlike `_ref_attention`'s
+    end-aligned causal mask, which assumes the query block sits at the
+    END of the key range).  GQA-native and f32-accumulating, matching
+    the `_ref_attention` precision contract.
+    """
+    B, H, Tq, D = q.shape
+    Hkv, Tmax = kcache.shape[1], kcache.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    g = H // Hkv
+    qg = q.reshape(B, Hkv, g, Tq, D)
+    s = jnp.einsum('bhgqd,bhkd->bhgqk', qg, kcache,
+                   preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(Tmax)
+    mask = kpos[None, None, :] <= qpos[:, :, None]        # [B, Tq, Tmax]
+    s = jnp.where(mask[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum('bhgqk,bhkd->bhgqd', p.astype(vcache.dtype), vcache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, H, Tq, D).astype(q.dtype)
+
+
+def write_cache(kcache, vcache, k, v, slot, layer, offset):
+    """Write one layer's new K/V for one slot at a position offset.
+
+    kcache/vcache: [S, L, Hkv, Tmax, D] slot-major pages; k/v:
+    [Hkv, C, D] for the C new positions of layer ``layer``; slot/offset
+    are traced scalars.  The write is a pure dynamic_update_slice so the
+    whole prefill/decode step stays one fused XLA program with the cache
+    as donated carry (no host round-trip per layer or per token).
+    """
+    k = k[None, None].astype(kcache.dtype)
+    v = v[None, None].astype(vcache.dtype)
+    idx = (slot, layer, 0, offset, 0)
+    return (jax.lax.dynamic_update_slice(kcache, k, idx),
+            jax.lax.dynamic_update_slice(vcache, v, idx))

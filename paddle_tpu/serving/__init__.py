@@ -16,6 +16,12 @@ breaker, and chaos-tested graceful degradation.  See docs/serving.md.
     result = engine.infer({'x': batch}, timeout_s=0.2)
     if result.ok:
         probs = result.outputs[0]
+
+The ``generation`` subpackage layers streaming autoregressive decode on
+top of this engine — slotted KV cache, fused decode windows, mixed
+prefill/decode batching, per-token TTFT/ITL SLOs (docs/generation.md)::
+
+    from paddle_tpu.serving.generation import GenerationEngine
 """
 from .admission import TokenBucket, OVERFLOW_POLICIES  # noqa
 from .breaker import CircuitBreaker, CLOSED, HALF_OPEN, OPEN  # noqa

@@ -1,0 +1,40 @@
+"""paddle_tpu.serving.generation — streaming autoregressive decode.
+
+The generation subsystem turns the ServingEngine into a streaming
+decode server (docs/generation.md):
+
+  * `kv_cache` — slotted per-request KV cache: preallocated
+    ``[slots, layers, kv_heads, max_len, head_dim]`` pages, free-list
+    slot allocation, per-slot length masks.
+  * `decode` — the fused prefill/decode executables: K decode tokens
+    launch as ONE `lax.scan` with the cache as donated carry (no host
+    round-trips inside the window), chunked/ring prefill, AOT-compiled
+    and persisted through the compile-cache disk tier.
+  * `sampling` — greedy / temperature / top-k draws keyed by
+    ``(request seed, absolute position)`` only, so fused and sequential
+    decode sample bitwise-identical streams (ops/sampling.py).
+  * `scheduler` — mixed prefill+decode continuous batching on the
+    PR-8 engine: prompts prefill one chunk per round, interleaved with
+    full-width decode windows, requests migrating prefill→decode slot
+    in place.
+  * `streaming` — per-token delivery through the engine reply path
+    with TTFT/ITL SLOs and EOS / max-token / cancel termination, all
+    resolving the terminal-reply invariant exactly once.
+
+    from paddle_tpu.serving import generation
+    engine = generation.GenerationEngine(runtime).start()
+    stream = engine.generate(prompt_ids, max_new=32, temperature=0.8,
+                             top_k=40, seed=7)
+    for tok in stream.tokens():
+        ...
+    result = stream.result()          # ServeResult, reason='eos'/...
+"""
+from .kv_cache import CacheConfig, SlotAllocator, init_state  # noqa
+from .decode import DecodeRuntime, dense_reference, weight_names  # noqa
+from .sampling import SamplingParams  # noqa
+from .streaming import TokenStream  # noqa
+from .scheduler import GenerationConfig, GenerationEngine  # noqa
+
+__all__ = ['CacheConfig', 'SlotAllocator', 'init_state', 'DecodeRuntime',
+           'dense_reference', 'weight_names', 'SamplingParams',
+           'TokenStream', 'GenerationConfig', 'GenerationEngine']

@@ -1,0 +1,556 @@
+"""Fused prefill/decode executables over the slotted KV cache.
+
+The decode hot loop is the few-large-fused-primitives shape: one AOT
+executable advances ALL cache slots K tokens as a single `lax.scan`
+with the cache pages as DONATED carry — no per-token Python dispatch,
+no host round-trips inside the window.  Inactive slots ride along under
+a mask (their writes land at their own row's next free position, which
+is overwritten before it is ever attended), so the executable signature
+never depends on which requests are live: one warm executable serves
+every batch composition forever (zero per-token retraces).
+
+Prefill is chunked: each chunk writes its K/V into the request's slot at
+its absolute offset and attends against the whole cache row with the
+positional mask ``kpos <= qpos`` (ops/attention.cached_attention), so a
+long prompt advances one bounded-cost chunk per scheduler round and
+never stalls the decode batch.  With a mesh carrying a >1 ``seq`` axis
+the runtime instead prefills long prompts in ONE shot through the exact
+ppermute ring (parallel/ring_attention.py) — same cache writes, same
+first-token logits (parity pinned at 1e-5 in tests/test_generation.py).
+
+Every executable is compiled ahead of time and persisted through the
+compile-cache disk tier (core/compile_cache.callable_fingerprint), so a
+restarted server warm-starts its decode loop from disk; fused-vs-
+sequential and fresh-vs-restored decode are bitwise-identical.
+"""
+import threading
+
+import numpy as np
+
+from ... import observability as _obs
+from ...core import compile_cache as _cc
+from ...ops.attention import cached_attention, write_cache
+from ...ops.sampling import sample_logits, sample_tokens_at, token_key
+from .kv_cache import CacheConfig, SlotAllocator, init_state
+
+__all__ = ['DecodeRuntime', 'dense_reference', 'weight_names',
+           'random_weights']
+
+_WEIGHT_SLOTS = ('att_q_w', 'att_k_w', 'att_v_w', 'att_o_w', 'att_norm',
+                 'ffn_norm', 'ffn_fc1_w', 'ffn_fc2_w', 'ffn_fc3_w')
+
+
+def weight_names(cfg):
+    """The decode-side parameter names — the same names a trained llama
+    program leaves in its scope (models/llama.py layout)."""
+    names = ['tok_emb', 'final_norm', 'lm_proj_w']
+    for i in range(int(cfg['n_layer'])):
+        names.extend('layer_%d_%s' % (i, s) for s in _WEIGHT_SLOTS)
+    return names
+
+
+def random_weights(cfg, seed=0, scale=0.08):
+    """Random-init weight dict with the llama layout (tests/soaks that
+    exercise the runtime without training a model first)."""
+    rng = np.random.RandomState(seed)
+    d, v, h = int(cfg['d_model']), int(cfg['vocab']), int(cfg['n_head'])
+    hkv, f = int(cfg['n_kv_head']), int(cfg['d_ffn'])
+    dh = d // h
+    shapes = {'tok_emb': (v, d), 'final_norm': (d,), 'lm_proj_w': (d, v)}
+    for i in range(int(cfg['n_layer'])):
+        p = 'layer_%d_' % i
+        shapes.update({p + 'att_q_w': (d, h * dh), p + 'att_k_w': (d, hkv * dh),
+                       p + 'att_v_w': (d, hkv * dh), p + 'att_o_w': (d, d),
+                       p + 'att_norm': (d,), p + 'ffn_norm': (d,),
+                       p + 'ffn_fc1_w': (d, f), p + 'ffn_fc3_w': (d, f),
+                       p + 'ffn_fc2_w': (f, d)})
+    out = {}
+    for n, s in shapes.items():
+        if n.endswith('norm'):
+            out[n] = np.ones(s, np.float32)
+        else:
+            out[n] = (scale * rng.randn(*s)).astype(np.float32)
+    return out
+
+
+# ------------------------------------------------------- forward pieces
+
+def _rms(x, scale):
+    import jax
+    import jax.numpy as jnp
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _rope_at(x, pos, theta):
+    """x: [B, h, T, dh]; pos: [B, T] absolute positions (per-row — decode
+    slots all sit at different lengths)."""
+    import jax.numpy as jnp
+    dh = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, dh // 2) * 2.0 / dh)
+    ang = pos[:, None, :, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    return jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                     axis=-1).reshape(x.shape)
+
+
+def _qkv(w, cfg, h, i):
+    """h: [B, T, D] -> q [B, H, T, dh], k/v [B, Hkv, T, dh] (pre-rope)."""
+    B, T = h.shape[0], h.shape[1]
+    H, Hkv = int(cfg['n_head']), int(cfg['n_kv_head'])
+    dh = int(cfg['d_model']) // H
+    p = 'layer_%d_' % i
+    q = (h @ w[p + 'att_q_w']).reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+    k = (h @ w[p + 'att_k_w']).reshape(B, T, Hkv, dh).transpose(0, 2, 1, 3)
+    v = (h @ w[p + 'att_v_w']).reshape(B, T, Hkv, dh).transpose(0, 2, 1, 3)
+    return q, k, v
+
+
+def _ffn(w, x, i):
+    import jax
+    p = 'layer_%d_' % i
+    hh = _rms(x, w[p + 'ffn_norm'])
+    gate = jax.nn.silu(hh @ w[p + 'ffn_fc1_w'])
+    return x + (gate * (hh @ w[p + 'ffn_fc3_w'])) @ w[p + 'ffn_fc2_w']
+
+
+def _prefill_fn(cfg, chunk, ring_mesh=None):
+    """Build the one-chunk (or one-shot ring) prefill function.
+
+    Writes the chunk's K/V into one slot's cache row at ``offset``,
+    attends the chunk queries against the whole row (positional mask),
+    SETS lengths[slot] = offset + true_count (no stale-state reset is
+    ever needed), samples the would-be next token at its absolute
+    position, and stores it in tok[slot].  Intermediate chunks' samples
+    are placeholders the next chunk overwrites — only the final chunk's
+    draw (the request's FIRST token, the TTFT token) survives.
+    """
+    import jax
+    import jax.numpy as jnp
+    L = int(cfg['n_layer'])
+    Hkv = int(cfg['n_kv_head'])
+    dh = int(cfg['d_model']) // int(cfg['n_head'])
+    theta = float(cfg['theta'])
+    Tmax = int(cfg['max_len'])
+
+    if ring_mesh is not None:
+        from ...parallel.ring_attention import ring_attention
+
+    def prefill(w, kc, vc, lengths, tok, tokens, slot, offset, true_count,
+                seed, temperature, top_k):
+        pos = (offset + jnp.arange(chunk))[None]          # [1, C]
+        x = w['tok_emb'][tokens][None]                    # [1, C, D]
+        for i in range(L):
+            h = _rms(x, w['layer_%d_att_norm' % i])
+            q, k, v = _qkv(w, cfg, h, i)
+            q = _rope_at(q, pos, theta)
+            k = _rope_at(k, pos, theta)
+            kc, vc = write_cache(kc, vc, k[0], v[0], slot, i, offset)
+            if ring_mesh is not None:
+                # one-shot long-context prefill (offset == 0): the exact
+                # ppermute ring over the whole prompt
+                att = ring_attention(q, k, v, ring_mesh, causal=True)
+            else:
+                row = (jax.lax.dynamic_slice(
+                    kc, (slot, i, 0, 0, 0), (1, 1, Hkv, Tmax, dh))[:, 0],
+                    jax.lax.dynamic_slice(
+                    vc, (slot, i, 0, 0, 0), (1, 1, Hkv, Tmax, dh))[:, 0])
+                att = cached_attention(q, row[0], row[1], pos)
+            B, H, T = att.shape[0], att.shape[1], att.shape[2]
+            att = att.transpose(0, 2, 1, 3).reshape(B, T, H * dh)
+            x = x + att @ w['layer_%d_att_o_w' % i]
+            x = _ffn(w, x, i)
+        x = _rms(x, w['final_norm'])
+        last = jax.lax.dynamic_slice_in_dim(x[0], true_count - 1, 1)[0]
+        logits = last @ w['lm_proj_w']                    # [V] f32
+        new_len = offset + true_count
+        nxt = sample_logits(logits, token_key(seed, new_len),
+                            temperature, top_k)
+        lengths = lengths.at[slot].set(new_len)
+        tok = tok.at[slot].set(nxt)
+        return kc, vc, lengths, tok, nxt, logits
+
+    return prefill
+
+
+def _decode_fn(cfg, steps):
+    """Build the K-step fused decode window over ALL slots.
+
+    Each step feeds every slot's ``tok`` at its own ``lengths`` position
+    (write K/V, attend against the row, sample the next token with the
+    position-keyed stream), then advances ACTIVE slots only.  Inactive
+    slots compute masked garbage: their write lands at their row's next
+    free position — overwritten before any query can reach it — and
+    their tok/lengths do not move.  The whole window is one `lax.scan`;
+    the cache/state arrays are donated carry.
+    """
+    import jax
+    import jax.numpy as jnp
+    L = int(cfg['n_layer'])
+    theta = float(cfg['theta'])
+    dh = int(cfg['d_model']) // int(cfg['n_head'])
+
+    def step(w, kc, vc, lengths, tok, active, seeds, temps, topks):
+        S = kc.shape[0]
+        pos = lengths                                     # [S] write pos
+        x = w['tok_emb'][tok][:, None, :]                 # [S, 1, D]
+        for i in range(L):
+            h = _rms(x, w['layer_%d_att_norm' % i])
+            q, k, v = _qkv(w, cfg, h, i)
+            q = _rope_at(q, pos[:, None], theta)
+            k = _rope_at(k, pos[:, None], theta)
+            write = jax.vmap(
+                lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0)))
+            kci = write(kc[:, i], k.astype(kc.dtype), pos)
+            vci = write(vc[:, i], v.astype(vc.dtype), pos)
+            kc = kc.at[:, i].set(kci)
+            vc = vc.at[:, i].set(vci)
+            att = cached_attention(q, kci, vci, pos[:, None])
+            H = att.shape[1]
+            att = att.transpose(0, 2, 1, 3).reshape(S, 1, H * dh)
+            x = x + att @ w['layer_%d_att_o_w' % i]
+            x = _ffn(w, x, i)
+        x = _rms(x, w['final_norm'])
+        logits = x[:, 0] @ w['lm_proj_w']                 # [S, V]
+        nxt = sample_tokens_at(logits, seeds, lengths + 1, temps, topks)
+        new_tok = jnp.where(active, nxt, tok)
+        new_len = jnp.where(active, lengths + 1, lengths)
+        return kc, vc, new_len, new_tok
+
+    def window(w, kc, vc, lengths, tok, active, seeds, temps, topks):
+        def body(carry, _):
+            kc, vc, lengths, tok = carry
+            kc, vc, lengths, tok = step(w, kc, vc, lengths, tok, active,
+                                        seeds, temps, topks)
+            return (kc, vc, lengths, tok), tok
+        (kc, vc, lengths, tok), toks = jax.lax.scan(
+            body, (kc, vc, lengths, tok), None, length=steps)
+        return kc, vc, lengths, tok, toks.T               # [S, K]
+
+    return window
+
+
+def dense_reference(weights, cfg, prompt):
+    """Independent prefill reference: ordinary dense causal attention
+    over the whole prompt — no cache pages, no positional masking, no
+    chunking (an intentionally different code path from
+    `cached_attention`).  Returns (k [L, Hkv, P, dh], v, last-position
+    logits [V]) for the parity tests."""
+    import jax
+    import jax.numpy as jnp
+    w = {n: jnp.asarray(weights[n]) for n in weight_names(cfg)}
+    L = int(cfg['n_layer'])
+    theta = float(cfg['theta'])
+    P = int(np.asarray(prompt).shape[-1])
+    pos = jnp.arange(P)[None]
+    x = w['tok_emb'][jnp.asarray(prompt, jnp.int32).reshape(1, P)]
+    ks, vs = [], []
+    for i in range(L):
+        h = _rms(x, w['layer_%d_att_norm' % i])
+        q, k, v = _qkv(w, cfg, h, i)
+        q = _rope_at(q, pos, theta)
+        k = _rope_at(k, pos, theta)
+        ks.append(k[0])
+        vs.append(v[0])
+        H, Hkv, dh = q.shape[1], k.shape[1], q.shape[-1]
+        qg = q.reshape(1, Hkv, H // Hkv, P, dh)
+        s = jnp.einsum('bhgqd,bhkd->bhgqk', qg, k,
+                       preferred_element_type=jnp.float32) * (dh ** -0.5)
+        mask = jnp.tril(jnp.ones((P, P), bool))
+        s = jnp.where(mask[None, None, None], s, -1e30)
+        att = jnp.einsum('bhgqk,bhkd->bhgqd', jax.nn.softmax(s, axis=-1), v,
+                         preferred_element_type=jnp.float32)
+        att = att.reshape(1, H, P, dh).transpose(0, 2, 1, 3)
+        x = x + att.reshape(1, P, H * dh) @ w['layer_%d_att_o_w' % i]
+        x = _ffn(w, x, i)
+    x = _rms(x, w['final_norm'])
+    logits = x[0, P - 1] @ w['lm_proj_w']
+    return (np.asarray(jnp.stack(ks)), np.asarray(jnp.stack(vs)),
+            np.asarray(logits))
+
+
+class DecodeRuntime(object):
+    """The device half of the streaming decode server: slotted KV cache
+    state + AOT prefill/decode executables over one weight set.
+
+    ``weights`` maps llama parameter names to arrays (a trained scope
+    via models.llama.generation_weights, or `random_weights` for tests);
+    ``cfg`` is the model config dict.  ``mesh`` (optional, with a >1
+    ``seq`` axis) enables one-shot ring prefill for prompts of at least
+    ``ring_min_len`` tokens.
+    """
+
+    def __init__(self, weights, cfg, slots=4, prefill_chunk=8,
+                 cache_dtype='float32', mesh=None, ring_min_len=None):
+        import jax.numpy as jnp
+        self.cfg = dict(cfg)
+        self.w = {n: jnp.asarray(weights[n]) for n in weight_names(cfg)}
+        H = int(cfg['n_head'])
+        self.cache = CacheConfig(
+            slots=slots, layers=int(cfg['n_layer']),
+            kv_heads=int(cfg['n_kv_head']), max_len=int(cfg['max_len']),
+            head_dim=int(cfg['d_model']) // H, dtype=cache_dtype)
+        self.allocator = SlotAllocator(self.cache.slots)
+        self.state = init_state(self.cache)
+        self.prefill_chunk = int(prefill_chunk)
+        if not 0 < self.prefill_chunk <= self.cache.max_len:
+            raise ValueError('prefill_chunk must be in (0, max_len]')
+        self.mesh = mesh
+        self.ring_min_len = (int(ring_min_len) if ring_min_len is not None
+                             else 2 * self.prefill_chunk)
+        self._execs = {}
+        self._lock = threading.Lock()
+        _obs.metrics.gauge('generation.kv_cache_bytes').set(
+            self.cache.bytes())
+
+    # ------------------------------------------------------- geometry
+    @property
+    def slots(self):
+        return self.cache.slots
+
+    @property
+    def max_len(self):
+        return self.cache.max_len
+
+    def free_slots(self):
+        return self.allocator.free_count()
+
+    def alloc_slot(self):
+        return self.allocator.alloc()
+
+    def free_slot(self, slot):
+        self.allocator.free(slot)
+
+    def reset(self):
+        """Fresh state + allocator (the weights and warm executables
+        stay)."""
+        self.allocator.reset()
+        self.state = init_state(self.cache)
+
+    # ---------------------------------------------------------- AOT
+    def _param_specs(self):
+        return {n: (tuple(a.shape), str(a.dtype))
+                for n, a in self.w.items()}
+
+    def _compiled(self, key, build):
+        """One executable per (kind, shape) key: AOT-lowered, donated
+        state, persisted through the compile-cache disk tier so a fresh
+        process warm-starts the decode loop without compiling."""
+        with self._lock:
+            call = self._execs.get(key)
+        if call is not None:
+            return call
+        _cc.ensure_xla_cache_backstop()
+        spec = {'fn': key[0], 'shape': list(key[1:]), 'cfg': self.cfg,
+                'cache': self.cache.spec(),
+                'mesh': _cc._mesh_blob(self.mesh) if key[0].endswith(
+                    'ring') else None}
+        fp = _cc.callable_fingerprint('generation', spec,
+                                      param_specs=self._param_specs())
+        call = None
+        if _cc.disk_enabled():
+            call, _tier = _cc.disk_cache().load(fp)
+            _obs.metrics.counter(
+                'compile_cache.disk_hits' if call is not None
+                else 'compile_cache.disk_misses').inc()
+        if call is None:
+            jitted, args = build()
+            lowered = jitted.lower(*args)
+            call = lowered.compile()
+            _obs.metrics.counter('generation.compiles').inc()
+            if _cc.disk_enabled():
+                _cc.disk_cache().store(fp, compiled=call, lowered=lowered,
+                                       meta={'kind': 'generation',
+                                             'fn': key[0]})
+        with self._lock:
+            self._execs[key] = call
+        return call
+
+    def _sds(self, shape, dtype):
+        """Arg struct for AOT lowering.  With a mesh every executable is
+        compiled for REPLICATED NamedSharding state, so the ring-prefill
+        and decode executables hand the donated cache back and forth
+        without a resharding mismatch."""
+        import jax
+        if self.mesh is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(self.mesh,
+                                                 PartitionSpec()))
+
+    def _state_structs(self):
+        st = self.state
+        return [self._sds(a.shape, a.dtype)
+                for a in (st['k'], st['v'], st['lengths'], st['tok'])]
+
+    def _prefill_exec(self, chunk, ring=False):
+        import jax
+
+        def build():
+            fn = _prefill_fn(self.cfg, chunk,
+                             ring_mesh=self.mesh if ring else None)
+            jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+            i32 = self._sds((), jax.numpy.int32)
+            f32 = self._sds((), jax.numpy.float32)
+            params = {n: self._sds(a.shape, a.dtype)
+                      for n, a in self.w.items()}
+            toks = self._sds((chunk,), jax.numpy.int32)
+            args = [params] + self._state_structs() + \
+                [toks, i32, i32, i32, i32, f32, i32]
+            return jitted, args
+
+        return self._compiled(('prefill_ring' if ring else 'prefill',
+                               chunk), build)
+
+    def _decode_exec(self, steps):
+        import jax
+
+        def build():
+            fn = _decode_fn(self.cfg, steps)
+            jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+            S = self.cache.slots
+            vec = lambda dt: self._sds((S,), dt)  # noqa: E731
+            params = {n: self._sds(a.shape, a.dtype)
+                      for n, a in self.w.items()}
+            args = [params] + self._state_structs() + \
+                [vec(jax.numpy.bool_), vec(jax.numpy.int32),
+                 vec(jax.numpy.float32), vec(jax.numpy.int32)]
+            return jitted, args
+
+        return self._compiled(('decode', steps), build)
+
+    def warmup(self, steps=None):
+        """Compile (or disk-load) the steady-state executables up front
+        so the first request pays no compile latency."""
+        self._prefill_exec(self.prefill_chunk)
+        if steps:
+            self._decode_exec(int(steps))
+
+    # -------------------------------------------------------- prefill
+    def prefill(self, slot, tokens, offset, params):
+        """Run ONE prefill chunk for ``slot``: tokens[offset:offset+C]
+        of the prompt (the final chunk may be short — it is padded to
+        the chunk width and masked by ``true_count``).  Returns
+        (next_token, logits) — meaningful only on the final chunk.
+        ``params`` is a SamplingParams."""
+        import jax.numpy as jnp
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        n = tokens.shape[0]
+        if not 0 < n <= self.prefill_chunk:
+            raise ValueError('chunk of %d tokens does not fit the %d-wide '
+                             'prefill executable' % (n, self.prefill_chunk))
+        if offset + n > self.cache.max_len:
+            raise ValueError('prefill past max_len=%d' % self.cache.max_len)
+        buf = np.zeros(self.prefill_chunk, np.int32)
+        buf[:n] = tokens
+        call = self._prefill_exec(self.prefill_chunk)
+        st = self.state
+        k, v, lengths, tok, nxt, logits = call(
+            self.w, st['k'], st['v'], st['lengths'], st['tok'],
+            jnp.asarray(buf), jnp.int32(slot), jnp.int32(offset),
+            jnp.int32(n), jnp.int32(params.seed),
+            jnp.float32(params.temperature), jnp.int32(params.top_k))
+        self.state = {'k': k, 'v': v, 'lengths': lengths, 'tok': tok}
+        return int(nxt), np.asarray(logits)
+
+    def ring_pad(self, n):
+        """Padded one-shot ring prefill width for an n-token prompt:
+        the next multiple of prefill_chunk (also a multiple of the ring
+        size when prefill_chunk is)."""
+        c = self.prefill_chunk
+        return min(((int(n) + c - 1) // c) * c, self.cache.max_len)
+
+    def prefill_ring(self, slot, prompt, params):
+        """One-shot long-context prefill through ring attention: the
+        whole (padded) prompt in a single launch.  Requires ``mesh``."""
+        import jax.numpy as jnp
+        if self.mesh is None:
+            raise ValueError('ring prefill needs a mesh with a seq axis')
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        n = prompt.shape[0]
+        width = self.ring_pad(n)
+        if n > width:
+            raise ValueError('prompt of %d exceeds max_len=%d'
+                             % (n, self.cache.max_len))
+        buf = np.zeros(width, np.int32)
+        buf[:n] = prompt
+        call = self._prefill_exec(width, ring=True)
+        st = self.state
+        k, v, lengths, tok, nxt, logits = call(
+            self.w, st['k'], st['v'], st['lengths'], st['tok'],
+            jnp.asarray(buf), jnp.int32(slot), jnp.int32(0),
+            jnp.int32(n), jnp.int32(params.seed),
+            jnp.float32(params.temperature), jnp.int32(params.top_k))
+        self.state = {'k': k, 'v': v, 'lengths': lengths, 'tok': tok}
+        return int(nxt), np.asarray(logits)
+
+    # --------------------------------------------------------- decode
+    def decode_window(self, steps, active, seeds, temps, topks):
+        """Advance every ACTIVE slot ``steps`` tokens in one fused
+        launch.  active/seeds/temps/topks are per-slot vectors (plain
+        data — they never retrace).  Returns the [slots, steps] token
+        matrix; inactive rows are garbage by contract."""
+        import jax.numpy as jnp
+        call = self._decode_exec(int(steps))
+        st = self.state
+        S = self.cache.slots
+        k, v, lengths, tok, toks = call(
+            self.w, st['k'], st['v'], st['lengths'], st['tok'],
+            jnp.asarray(np.asarray(active, bool).reshape(S)),
+            jnp.asarray(np.asarray(seeds, np.int32).reshape(S)),
+            jnp.asarray(np.asarray(temps, np.float32).reshape(S)),
+            jnp.asarray(np.asarray(topks, np.int32).reshape(S)))
+        self.state = {'k': k, 'v': v, 'lengths': lengths, 'tok': tok}
+        return np.asarray(toks)
+
+    # ----------------------------------------------- test conveniences
+    def cache_row(self, slot):
+        """Host copies (k [L, Hkv, Tmax, dh], v, length) of one slot."""
+        st = self.state
+        return (np.asarray(st['k'][slot]), np.asarray(st['v'][slot]),
+                int(np.asarray(st['lengths'][slot])))
+
+    def generate(self, prompt, max_new, params=None, steps_per_window=4,
+                 use_ring=False):
+        """Single-request convenience decode (tests, parity references):
+        prefill the prompt, then advance in fused windows; returns the
+        generated ids (list, length max_new).  steps_per_window=1 IS the
+        sequential single-token reference path."""
+        from .sampling import SamplingParams
+        params = params or SamplingParams()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size + int(max_new) > self.cache.max_len:
+            raise ValueError(
+                'prompt of %d + max_new=%d exceeds max_len=%d — requests '
+                'are never truncated; shorten the prompt or lower max_new'
+                % (prompt.size, max_new, self.cache.max_len))
+        slot = self.alloc_slot()
+        if slot is None:
+            raise RuntimeError('no free kv slot')
+        try:
+            if use_ring:
+                first, _ = self.prefill_ring(slot, prompt, params)
+            else:
+                first = None
+                for off in range(0, prompt.size, self.prefill_chunk):
+                    chunk = prompt[off:off + self.prefill_chunk]
+                    first, _ = self.prefill(slot, chunk, off, params)
+            out = [int(first)]
+            S = self.cache.slots
+            active = np.zeros(S, bool)
+            active[slot] = True
+            seeds = np.zeros(S, np.int32)
+            temps = np.zeros(S, np.float32)
+            topks = np.zeros(S, np.int32)
+            seeds[slot] = params.seed
+            temps[slot] = params.temperature
+            topks[slot] = params.top_k
+            while len(out) < int(max_new):
+                toks = self.decode_window(int(steps_per_window), active,
+                                          seeds, temps, topks)
+                out.extend(int(t) for t in toks[slot])
+            return out[:int(max_new)]
+        finally:
+            self.free_slot(slot)

@@ -1,0 +1,119 @@
+"""Slotted per-request KV cache.
+
+One preallocated pair of ``[slots, layers, kv_heads, max_len, head_dim]``
+pages holds every in-flight request's keys/values; a request owns one
+slot index from admission to termination (prefill and decode write the
+same row — migration is a no-op by construction).  Allocation is a
+host-side free list; device state is the page pair plus per-slot
+``lengths`` (tokens written) and ``tok`` (next token to feed) vectors,
+threaded as DONATED carry through the fused decode loop (decode.py).
+
+Masking is positional, not zeroing: a freed slot's stale rows are never
+cleared — the next occupant's prefill SETS ``lengths[slot]`` and
+overwrites positions from 0, and attention masks ``kpos <= qpos``, so
+stale garbage beyond the live prefix is unreachable.  That keeps
+slot turnover O(1) with zero device work.
+"""
+import threading
+
+import numpy as np
+
+from ... import observability as _obs
+
+__all__ = ['CacheConfig', 'SlotAllocator', 'init_state']
+
+
+class CacheConfig(object):
+    """Geometry of the slotted cache pages."""
+    __slots__ = ('slots', 'layers', 'kv_heads', 'max_len', 'head_dim',
+                 'dtype')
+
+    def __init__(self, slots, layers, kv_heads, max_len, head_dim,
+                 dtype='float32'):
+        if int(slots) < 1:
+            raise ValueError('kv cache needs >= 1 slot, got %r' % (slots,))
+        self.slots = int(slots)
+        self.layers = int(layers)
+        self.kv_heads = int(kv_heads)
+        self.max_len = int(max_len)
+        self.head_dim = int(head_dim)
+        self.dtype = str(dtype)
+
+    @property
+    def page_shape(self):
+        return (self.slots, self.layers, self.kv_heads, self.max_len,
+                self.head_dim)
+
+    def bytes(self):
+        """Total K+V page bytes (capacity-planning helper)."""
+        per = int(np.dtype(self.dtype).itemsize)
+        return 2 * per * int(np.prod(self.page_shape))
+
+    def spec(self):
+        """Declarative blob for the AOT cache fingerprint."""
+        return {'slots': self.slots, 'layers': self.layers,
+                'kv_heads': self.kv_heads, 'max_len': self.max_len,
+                'head_dim': self.head_dim, 'dtype': self.dtype}
+
+
+def init_state(cache_cfg):
+    """Fresh device-side decode state: the K/V pages plus per-slot
+    ``lengths`` (tokens written so far) and ``tok`` (the next token to
+    feed — set by prefill, advanced by every decode step)."""
+    import jax.numpy as jnp
+    k = jnp.zeros(cache_cfg.page_shape, jnp.dtype(cache_cfg.dtype))
+    return {'k': k, 'v': jnp.zeros_like(k),
+            'lengths': jnp.zeros((cache_cfg.slots,), jnp.int32),
+            'tok': jnp.zeros((cache_cfg.slots,), jnp.int32)}
+
+
+class SlotAllocator(object):
+    """Free-list slot allocation.  Lowest-index-first for deterministic
+    placement (the same admission order always lands on the same slots,
+    which keeps soak runs reproducible).  Exports the live occupancy as
+    the ``generation.kv_slots_in_use`` gauge."""
+
+    def __init__(self, slots):
+        self._capacity = int(slots)
+        self._free = list(range(self._capacity))
+        self._lock = threading.Lock()
+        _obs.metrics.gauge('generation.kv_slots_in_use').set(0)
+
+    @property
+    def capacity(self):
+        return self._capacity
+
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+    def in_use(self):
+        return self._capacity - self.free_count()
+
+    def alloc(self):
+        """Claim the lowest free slot, or None when fully occupied."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = min(self._free)
+            self._free.remove(slot)
+            used = self._capacity - len(self._free)
+        _obs.metrics.gauge('generation.kv_slots_in_use').set(used)
+        return slot
+
+    def free(self, slot):
+        slot = int(slot)
+        with self._lock:
+            if not 0 <= slot < self._capacity:
+                raise ValueError('slot %d out of range [0, %d)'
+                                 % (slot, self._capacity))
+            if slot in self._free:
+                raise ValueError('double free of kv slot %d' % slot)
+            self._free.append(slot)
+            used = self._capacity - len(self._free)
+        _obs.metrics.gauge('generation.kv_slots_in_use').set(used)
+
+    def reset(self):
+        with self._lock:
+            self._free = list(range(self._capacity))
+        _obs.metrics.gauge('generation.kv_slots_in_use').set(0)

@@ -1,0 +1,37 @@
+"""Per-request sampling parameters for the streaming decode runtime.
+
+The math lives in ops/sampling.py (pure, position-keyed, registered as
+the `sample_tokens` Program op); this module carries the per-request
+knobs through the scheduler and packs them into the fixed-width per-slot
+vectors the decode executable takes — sampling parameters are DATA, not
+part of the executable signature, so a greedy request and a top-k
+request share one warm executable (zero per-request retraces).
+
+Determinism contract (pinned by tests/test_generation.py): token at
+absolute position ``p`` of a request with seed ``s`` is drawn with key
+``fold_in(key(s), p)`` — independent of batch composition, window size
+K, scheduler interleaving, and fresh-vs-restored executables.
+"""
+from ...ops.sampling import (sample_logits, sample_tokens_at,  # noqa
+                             token_key)
+
+__all__ = ['SamplingParams', 'sample_logits', 'sample_tokens_at',
+           'token_key']
+
+
+class SamplingParams(object):
+    """One request's sampling knobs.  ``temperature <= 0`` is greedy;
+    ``top_k > 0`` restricts the draw to the k highest logits; ``seed``
+    is the request's whole entropy (same seed -> same stream)."""
+    __slots__ = ('temperature', 'top_k', 'seed')
+
+    def __init__(self, temperature=0.0, top_k=0, seed=0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        if self.top_k < 0:
+            raise ValueError('top_k must be >= 0, got %r' % (top_k,))
+        self.seed = int(seed)
+
+    def __repr__(self):
+        return ('SamplingParams(temperature=%g, top_k=%d, seed=%d)'
+                % (self.temperature, self.top_k, self.seed))

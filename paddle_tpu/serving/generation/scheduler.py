@@ -1,0 +1,417 @@
+"""GenerationEngine — prefill/decode continuous batching on the serving
+engine.
+
+The PR-8 ServingEngine coalesces same-signature one-shot requests into
+superbatches; generation requests are long-lived instead, so this
+subclass replaces the dispatch loop with a round-based scheduler over
+the DecodeRuntime's KV slots:
+
+  round := sweep (cancel / deadline / TTFT / ITL)
+         → claim queued requests into free slots
+         → ONE prefill chunk for the oldest still-prefilling request
+         → ONE fused decode window for ALL decoding slots
+
+Long prompts therefore advance one bounded chunk per round, interleaved
+with full-width decode windows — a prompt of any length never stalls
+token delivery for running requests (``generation.mixed_dispatches``
+counts rounds that did both).  A request lives in one slot from prefill
+through decode (migration is in place by construction) and every
+admitted request keeps the PR-8 guarantee: exactly one terminal reply —
+``ok`` (reason ``eos`` / ``max_tokens``), ``deadline_exceeded`` (queue
+wait, overall deadline, TTFT or ITL budget), ``shed`` (cancel, drain),
+``rejected`` (admission), or ``error`` (decode fault) — through drain,
+stop, and injected ``decode_step`` faults alike.
+
+Token-level SLOs: ``serving.ttft_ms`` observes submit→first-token per
+request, ``serving.itl_ms`` the amortized inter-token gap; both export
+through telemetry_snapshot('serving') (docs/generation.md).
+"""
+import time
+
+import numpy as np
+
+from ... import observability as _obs
+from ...observability import flight as _flight
+from ...observability import trace_context as _tc
+from ...testing import faults as _faults
+from ..engine import (DEADLINE_EXCEEDED, DRAINING, ERROR, OK, SHED,
+                      ServingEngine, _Request)
+from .sampling import SamplingParams
+from .streaming import TokenStream
+
+__all__ = ['GenerationConfig', 'GenerationEngine']
+
+
+class GenerationConfig(object):
+    """Generation-side knobs (the queue/rate/breaker knobs stay on
+    ServingConfig).  ``decode_window`` is K, the tokens-per-launch of
+    the fused decode scan; ``ttft_timeout_s`` / ``itl_timeout_s`` are
+    the default per-token SLO budgets (overridable per request)."""
+
+    def __init__(self, decode_window=4, eos_id=None, max_new_default=16,
+                 ttft_timeout_s=None, itl_timeout_s=None):
+        if int(decode_window) < 1:
+            raise ValueError('decode_window must be >= 1')
+        self.decode_window = int(decode_window)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.max_new_default = int(max_new_default)
+        self.ttft_timeout_s = ttft_timeout_s
+        self.itl_timeout_s = itl_timeout_s
+
+
+class _GenRequest(_Request):
+    __slots__ = ('prompt', 'max_new', 'params', 'ttft_timeout',
+                 'itl_timeout', 'slot', 'offset', 'produced',
+                 't_last_token')
+
+    def __init__(self, prompt, max_new, params, deadline, t_submit,
+                 ttft_timeout=None, itl_timeout=None, trace=None,
+                 t_pc=None):
+        _Request.__init__(self, {'prompt': prompt}, 1,
+                          ('generate',), deadline, t_submit,
+                          trace=trace, t_pc=t_pc)
+        self.future = TokenStream()   # streaming reply handle
+        if trace is not None:
+            self.future.traceparent = trace.to_traceparent()
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.params = params
+        self.ttft_timeout = ttft_timeout
+        self.itl_timeout = itl_timeout
+        self.slot = None
+        self.offset = 0          # prompt tokens prefilled so far
+        self.produced = 0        # tokens streamed so far
+        self.t_last_token = None
+
+
+class GenerationEngine(ServingEngine):
+    """Streaming decode server over one :class:`DecodeRuntime`.
+
+        engine = GenerationEngine(runtime).start()
+        stream = engine.generate([1, 2, 3], max_new=32, temperature=0.8,
+                                 top_k=40, seed=7)
+        for tok in stream.tokens():
+            ...
+        reply = stream.result()       # ServeResult, reason='eos'/...
+
+    Admission (queue bound, overflow policy, rate limit, drain gate) is
+    inherited; ``submit()`` is closed off — generation requests go
+    through :meth:`generate`.
+    """
+
+    def __init__(self, runtime, config=None, gen_config=None,
+                 clock=time.monotonic):
+        ServingEngine.__init__(self, self._no_backend, bucketer=None,
+                               config=config, clock=clock)
+        self.runtime = runtime
+        self._gen = gen_config or GenerationConfig()
+        self._active = []        # slot-holding requests, admission order
+
+    @staticmethod
+    def _no_backend(feed):
+        raise TypeError('GenerationEngine has no one-shot backend; '
+                        'requests go through generate()')
+
+    def submit(self, feed, timeout_s=None):
+        raise TypeError('GenerationEngine serves token streams — use '
+                        'generate(prompt_ids, ...) instead of submit()')
+
+    # ----------------------------------------------------- admission
+    def _rejected_gen(self, t_submit, reason, message, trace, t_pc):
+        # the base _rejected builds a plain ServeFuture; generation
+        # refusals must still hand back an (already-closed) TokenStream
+        from ..engine import REJECTED, ServeResult
+        fut = TokenStream()
+        if trace is not None:
+            fut.traceparent = trace.to_traceparent()
+        fut._resolve(ServeResult(REJECTED, error=message, reason=reason,
+                                 latency_s=self._clock() - t_submit))
+        _obs.metrics.counter('serving.rejected').inc()
+        _obs.metrics.counter('serving.rejected.%s' % reason).inc()
+        self._emit_root_span(trace, t_pc, REJECTED, reason=reason)
+        return fut
+
+    def generate(self, prompt_ids, max_new=None, temperature=0.0, top_k=0,
+                 seed=0, timeout_s=None, ttft_timeout_s=None,
+                 itl_timeout_s=None):
+        """Admit one generation request; always returns a
+        :class:`TokenStream` (refusals come back already terminal with a
+        named reason, never an exception and never silence)."""
+        t_submit = self._clock()
+        obs_on = _obs.enabled()
+        trace = _tc.TraceContext.new() if obs_on else None
+        t_pc = time.perf_counter() if obs_on else None
+        _obs.metrics.counter('serving.submitted').inc()
+        try:
+            prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+            params = SamplingParams(temperature=temperature, top_k=top_k,
+                                    seed=seed)
+        except Exception as e:  # noqa: BLE001 - refusal, not crash
+            return self._rejected_gen(t_submit, 'bad_request',
+                                      'unusable request: %r' % (e,),
+                                      trace, t_pc)
+        if prompt.size == 0:
+            return self._rejected_gen(t_submit, 'bad_request',
+                                      'empty prompt', trace, t_pc)
+        if max_new is None:
+            max_new = self._gen.max_new_default
+        if int(max_new) < 1:
+            return self._rejected_gen(t_submit, 'bad_request',
+                                      'max_new must be >= 1, got %r'
+                                      % (max_new,), trace, t_pc)
+        limit = self.runtime.max_len
+        if prompt.size + int(max_new) > limit:
+            # the hard context ceiling: refuse with the arithmetic
+            # spelled out — a prompt is NEVER silently truncated
+            return self._rejected_gen(
+                t_submit, 'too_long',
+                'prompt of %d tokens + max_new=%d exceeds the runtime '
+                'context window max_len=%d; shorten the prompt or lower '
+                'max_new — nothing is silently truncated'
+                % (prompt.size, int(max_new), limit), trace, t_pc)
+        if timeout_s is None:
+            timeout_s = self._cfg.default_timeout_s
+        deadline = None
+        if timeout_s is not None:
+            if timeout_s <= 0:
+                return self._rejected_gen(
+                    t_submit, 'deadline',
+                    'deadline already expired at admission '
+                    '(timeout_s=%r)' % timeout_s, trace, t_pc)
+            deadline = t_submit + float(timeout_s)
+        if self._rate is not None and not self._rate.try_acquire():
+            return self._rejected_gen(
+                t_submit, 'rate', 'token-bucket rate limit exceeded '
+                '(rate_qps=%r)' % self._cfg.rate_qps, trace, t_pc)
+        req = _GenRequest(
+            prompt, int(max_new), params, deadline, t_submit,
+            ttft_timeout=(self._gen.ttft_timeout_s if ttft_timeout_s is None
+                          else ttft_timeout_s),
+            itl_timeout=(self._gen.itl_timeout_s if itl_timeout_s is None
+                         else itl_timeout_s),
+            trace=trace, t_pc=t_pc)
+        fut = self._admit(req, t_submit)
+        if trace is not None:
+            t_now = time.perf_counter()
+            _obs.tracing.recorder().add_complete(
+                'serving.submit', t_pc, t_now, cat='serving',
+                args=trace.span_args(prompt_tokens=int(prompt.size),
+                                     max_new=int(max_new)))
+            _obs.tracing.add_flow(trace.trace_id[:16], 's', t_pc,
+                                  name='serving.link', cat='serving')
+        return fut
+
+    # ----------------------------------------------------- scheduling
+    def _loop(self):
+        try:
+            while self._round():
+                pass
+        finally:
+            # slot-holding requests get their terminal (shed) reply
+            # BEFORE the base deadlock audit counts stragglers
+            self._shed_active()
+            self._finish_stop()
+
+    def _round(self):
+        """One scheduler round; False means the loop should exit."""
+        with self._cond:
+            while not self._queue and not self._active:
+                if self._stopping or self._state == DRAINING:
+                    return False
+                self._cond.wait(0.05)
+            if self._stopping:
+                return False
+            now = self._clock()
+            expired, dropped = [], []
+            for r in list(self._queue):
+                if r.deadline is not None and r.deadline <= now:
+                    expired.append(r)
+                elif r.future.cancelled:
+                    dropped.append(r)
+            if expired or dropped:
+                gone = set(map(id, expired + dropped))
+                self._queue = type(self._queue)(
+                    r for r in self._queue if id(r) not in gone)
+            while self._queue:
+                slot = self.runtime.alloc_slot()
+                if slot is None:
+                    break
+                r = self._queue.popleft()
+                r.slot = slot
+                self._active.append(r)
+            _obs.metrics.gauge('serving.queue_depth').set(len(self._queue))
+            self._cond.notify_all()
+        for r in expired:
+            self._resolve(r, DEADLINE_EXCEEDED, reason='queue_wait',
+                          error='deadline expired while queued; dropped '
+                                'pre-dispatch (no compute was spent)')
+        for r in dropped:
+            _obs.metrics.counter('generation.cancelled').inc()
+            self._resolve(r, SHED, reason='cancelled',
+                          error='cancelled while queued')
+        self._sweep_active()
+        did_prefill = self._prefill_step()
+        did_decode = self._decode_step()
+        if did_prefill and did_decode:
+            _obs.metrics.counter('generation.mixed_dispatches').inc()
+        return True
+
+    def _sweep_active(self):
+        """Terminal conditions checked at every round boundary."""
+        now = self._clock()
+        for r in list(self._active):
+            if r.future.cancelled:
+                _obs.metrics.counter('generation.cancelled').inc()
+                self._retire(r, SHED, reason='cancelled',
+                             error='cancelled by the client mid-stream')
+            elif r.deadline is not None and r.deadline <= now:
+                self._retire(r, DEADLINE_EXCEEDED, reason='deadline',
+                             error='overall deadline expired mid-stream')
+            elif r.ttft_timeout is not None and r.produced == 0 \
+                    and now - r.t_submit > r.ttft_timeout:
+                self._retire(r, DEADLINE_EXCEEDED, reason='ttft',
+                             error='no first token within the TTFT '
+                                   'budget (%gs)' % r.ttft_timeout)
+            elif r.itl_timeout is not None and r.produced > 0 \
+                    and now - r.t_last_token > r.itl_timeout:
+                self._retire(r, DEADLINE_EXCEEDED, reason='itl',
+                             error='inter-token gap exceeded the ITL '
+                                   'budget (%gs)' % r.itl_timeout)
+
+    def _prefill_step(self):
+        """Advance the OLDEST still-prefilling request by one chunk (or
+        one ring shot).  Bounded work per round: long prompts cannot
+        starve the decode batch."""
+        rt = self.runtime
+        pre = [r for r in self._active if r.offset < r.prompt.size]
+        if not pre:
+            return False
+        r = min(pre, key=lambda x: x.t_submit)
+        t0 = time.perf_counter()
+        use_ring = (rt.mesh is not None and r.offset == 0
+                    and r.prompt.size >= rt.ring_min_len)
+        try:
+            if use_ring:
+                first, _logits = rt.prefill_ring(r.slot, r.prompt, r.params)
+                r.offset = int(r.prompt.size)
+            else:
+                chunk = r.prompt[r.offset:r.offset + rt.prefill_chunk]
+                first, _logits = rt.prefill(r.slot, chunk, r.offset,
+                                            r.params)
+                r.offset += int(chunk.size)
+        except BaseException as e:  # noqa: BLE001 - replied per request
+            self.breaker.record_failure()
+            _obs.metrics.counter('serving.batch_failures').inc()
+            _flight.record('serving.prefill_failure', error=repr(e)[:300])
+            self._retire(r, ERROR, error=e, reason='prefill')
+            _flight.maybe_dump('serving_prefill_failure')
+            return True
+        _obs.metrics.counter('generation.prefill_chunks').inc()
+        if r.trace is not None:
+            _obs.tracing.recorder().add_complete(
+                'serving.prefill', t0, time.perf_counter(), cat='serving',
+                args={'trace_id': r.trace.trace_id,
+                      'parent_span_id': r.trace.span_id,
+                      'slot': int(r.slot), 'offset': int(r.offset),
+                      'ring': bool(use_ring)})
+        if r.offset >= r.prompt.size:
+            # prompt complete: the final chunk's sample IS the first
+            # token (TTFT)
+            self._emit_tokens(r, [int(first)])
+        return True
+
+    def _decode_step(self):
+        """One fused K-token window over every decoding slot."""
+        rt = self.runtime
+        dec = [r for r in self._active if r.offset >= r.prompt.size]
+        if not dec:
+            return False
+        S, K = rt.slots, self._gen.decode_window
+        active = np.zeros(S, bool)
+        seeds = np.zeros(S, np.int32)
+        temps = np.zeros(S, np.float32)
+        topks = np.zeros(S, np.int32)
+        for r in dec:
+            active[r.slot] = True
+            seeds[r.slot] = r.params.seed
+            temps[r.slot] = r.params.temperature
+            topks[r.slot] = r.params.top_k
+        t0 = time.perf_counter()
+        try:
+            if _faults.any_active():
+                _faults.maybe_fail('decode_step')
+            toks = rt.decode_window(K, active, seeds, temps, topks)
+        except BaseException as e:  # noqa: BLE001 - replied per request
+            self.breaker.record_failure()
+            _obs.metrics.counter('serving.batch_failures').inc()
+            _flight.record('serving.decode_failure', error=repr(e)[:300],
+                           requests=len(dec), steps=int(K))
+            for r in dec:
+                self._retire(r, ERROR, error=e, reason='decode_step')
+            _flight.maybe_dump('serving_decode_failure')
+            return False
+        self.breaker.record_success(cold=False)
+        _obs.metrics.counter('generation.decode_windows').inc()
+        if _obs.enabled():
+            links = [r.trace.trace_id for r in dec if r.trace is not None]
+            _obs.tracing.recorder().add_complete(
+                'serving.decode_step', t0, time.perf_counter(),
+                cat='serving', args={'steps': int(K), 'requests': len(dec),
+                                     'links': links})
+        for r in list(dec):
+            self._emit_tokens(r, [int(t) for t in toks[r.slot]])
+        return True
+
+    # ----------------------------------------------------- token path
+    def _emit_tokens(self, r, toks):
+        """Stream tokens to the client; finish on EOS / max_tokens."""
+        now = self._clock()
+        first = r.produced == 0
+        if first:
+            _obs.metrics.histogram('serving.ttft_ms').observe(
+                max(0.0, (now - r.t_submit) * 1e3))
+        elif toks:
+            # the fused window delivers K tokens at once: observe the
+            # amortized per-token gap K times so the ITL histogram
+            # weighs every token, not every window
+            gap_ms = max(0.0, (now - r.t_last_token) * 1e3) / len(toks)
+            h = _obs.metrics.histogram('serving.itl_ms')
+            for _ in range(min(len(toks), r.max_new - r.produced)):
+                h.observe(gap_ms)
+        finish = None
+        for tok in toks:
+            r.future._push(tok)
+            r.produced += 1
+            _obs.metrics.counter('generation.tokens').inc()
+            if r.trace is not None:
+                _obs.tracing.instant(
+                    'serving.token', cat='serving',
+                    args={'trace_id': r.trace.trace_id,
+                          'index': int(r.produced)})
+            if self._gen.eos_id is not None and tok == self._gen.eos_id:
+                finish = 'eos'
+                break
+            if r.produced >= r.max_new:
+                finish = 'max_tokens'
+                break
+        r.t_last_token = now
+        if finish is not None:
+            ids = np.asarray(r.future.tokens_so_far(), np.int64)
+            self._retire(r, OK, outputs=[ids], reason=finish)
+
+    def _retire(self, r, status, outputs=None, error=None, reason=None):
+        """Terminal resolution for a slot-holding request: drop it from
+        the round-robin, release the KV slot, resolve the stream."""
+        if r in self._active:
+            self._active.remove(r)
+        if r.slot is not None:
+            self.runtime.free_slot(r.slot)
+            r.slot = None
+        self._resolve(r, status, outputs=outputs, error=error,
+                      reason=reason)
+
+    def _shed_active(self):
+        for r in list(self._active):
+            self._retire(r, SHED, reason='shutdown',
+                         error='engine stopped mid-stream; partial output '
+                               'is in tokens_so_far()')

@@ -58,6 +58,11 @@ Instrumented sites (kept in sync with docs/robustness.md):
                    simulated crash) this is a transient blip that
                    ``retry_with_backoff`` must absorb
                    (train/checkpoint.py)
+  ``decode_step``  one fused decode window of the streaming generation
+                   scheduler raises BEFORE the runtime is touched —
+                   every decoding request gets an error reply, the KV
+                   slots free, and the breaker counts a failure
+                   (serving/generation/scheduler.py)
   ``device_loss``  a pod participant stops heartbeating at step ``at``
                    and hangs — peers must detect the loss and trip
                    recovery instead of waiting on a dead collective
@@ -82,7 +87,7 @@ __all__ = ['configure', 'reset', 'any_active', 'active', 'fire', 'fire_in',
 SITES = ('ckpt_write', 'ckpt_io', 'cache_read', 'cache_write', 'io_read',
          'io_write', 'nan_step', 'prefetch_stall', 'sigterm',
          'serve_dispatch', 'serve_slow_batch', 'queue_overflow',
-         'compile_storm', 'device_loss', 'host_desync')
+         'compile_storm', 'decode_step', 'device_loss', 'host_desync')
 
 
 class InjectedFault(OSError):

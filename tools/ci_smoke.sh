@@ -200,6 +200,33 @@ if [ "$serve_rc" -ne 0 ]; then
 fi
 rm -rf "$flight_dir"
 
+echo "== ci_smoke: decode soak (streaming generation under chaos) =="
+# generation gate (docs/generation.md): serve_soak --scenario decode
+# drives a GenerationEngine — slotted KV cache, chunked prefill
+# interleaved with fused decode windows, per-token streaming — with
+# open-loop traffic of mixed prompt lengths, mid-soak cancellations,
+# periodic overlong prompts (must be REFUSED, never truncated), and a
+# decode_step fault that must turn into clean error replies while the
+# engine keeps serving.  --assert-slo fails the gate unless the
+# accounting identity holds (terminal == admitted), serving.deadlocks
+# == 0, TTFT/ITL histograms are populated, at least one mixed
+# prefill+decode dispatch happened, zero compiles landed after warmup
+# (the fused window executables are closed over batch composition),
+# and every KV slot is back on the free list after drain.  PT_CACHE=1
+# so the decode/prefill executables round-trip the persistent AOT
+# cache on repeat runs.
+decode_cache=$(mktemp -d /tmp/pt_decode_cache.XXXXXX)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=1 \
+    PT_CACHE_DIR="$decode_cache" \
+    PT_FAULT="decode_step:at=3" \
+    python tools/serve_soak.py --scenario decode --requests 40 --qps 60 \
+    --assert-slo
+decode_rc=$?
+if [ "$decode_rc" -ne 0 ]; then
+    echo "ci_smoke: decode soak FAILED (rc=$decode_rc)"
+fi
+rm -rf "$decode_cache"
+
 echo "== ci_smoke: tier-1 tests =="
 set -o pipefail
 rm -f /tmp/_t1.log
@@ -273,7 +300,9 @@ if obs_export.schema_keys('bench') != tel_expected:
              'telemetry keys: %r' % (obs_export.schema_keys('bench'),))
 for section, need in (('serving', ('admitted', 'terminal_replies',
                                    'shed_rate', 'p50_ms', 'p99_ms',
-                                   'counters')),
+                                   'ttft_p50_ms', 'ttft_p99_ms',
+                                   'itl_p50_ms', 'itl_p99_ms',
+                                   'kv_slots_in_use', 'counters')),
                       ('resilience', ('counters',))):
     have = obs_export.schema_keys(section)
     absent = [k for k in need if k not in have]
@@ -332,4 +361,5 @@ fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$soak_rc" -eq 0 ] && \
-    [ "$resume_rc" -eq 0 ] && [ "$pod_rc" -eq 0 ] && [ "$serve_rc" -eq 0 ]
+    [ "$resume_rc" -eq 0 ] && [ "$pod_rc" -eq 0 ] && \
+    [ "$serve_rc" -eq 0 ] && [ "$decode_rc" -eq 0 ]

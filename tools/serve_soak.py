@@ -48,6 +48,13 @@ Observability gates (docs/observability.md):
     `fault.injected` serve_dispatch event (the mid-batch crash left a
     usable postmortem).
 
+``--scenario decode`` switches to the streaming-generation soak
+(`run_decode_scenario`): open-loop token-stream load with mixed prompt
+lengths, mid-soak cancellations, overlong-prompt refusals, the
+``decode_step`` fault site, and token-level SLO gates (TTFT/ITL
+histograms, bitwise greedy parity, zero post-warmup compiles, no KV
+slot leaks) — see docs/generation.md.
+
 Prints one JSON line with the verdict and the metrics that prove it
 (the serving block comes from observability.telemetry_snapshot, the
 same schema bench.py and fault_soak.py print).
@@ -96,8 +103,170 @@ def build_stub_backend(latency_s):
     return backend
 
 
+def run_decode_scenario(args):
+    """Streaming-decode soak (--scenario decode): open-loop generation
+    load with mixed prompt lengths against a GenerationEngine, mid-soak
+    client cancellations, and deliberately-overlong prompts that must be
+    refused (never truncated).  Asserts, under the armed PT_FAULT matrix
+    (``decode_step`` breaks one fused window mid-soak):
+
+      * zero no-reply streams and ``serving.deadlocks == 0``; admitted
+        == completed + errors + deadline_exceeded + shed
+      * TTFT and ITL histograms populated (the telemetry quantiles are
+        finite)
+      * at least one mixed prefill+decode dispatch round
+      * bitwise greedy parity: the engine's fused K-token stream equals
+        a sequential (K=1) single-request reference
+      * ZERO new executable compiles after warmup — batch composition,
+        prompt length, and sampling params never retrace
+      * every KV slot returned to the free list after drain
+    """
+    import numpy as np
+    import paddle_tpu.observability as obs
+    from paddle_tpu.observability import flight as _flight
+    from paddle_tpu.serving.engine import ServingConfig
+    from paddle_tpu.serving.generation import (DecodeRuntime,
+                                               GenerationConfig,
+                                               GenerationEngine)
+    from paddle_tpu.serving.generation.decode import random_weights
+
+    _flight.install()
+    cfg = dict(vocab=128, d_model=32, n_layer=2, n_head=4, n_kv_head=2,
+               d_ffn=64, theta=10000.0, max_len=32)
+    w = random_weights(cfg, seed=0)
+    rt = DecodeRuntime(w, cfg, slots=args.slots, prefill_chunk=4)
+    K = args.decode_window
+    engine = GenerationEngine(
+        rt, config=ServingConfig(max_queue=args.max_queue,
+                                 drain_timeout_s=30.0),
+        gen_config=GenerationConfig(decode_window=K)).start()
+
+    # parity gate first (its executables land before the warmup
+    # snapshot): fused engine stream == sequential K=1 reference
+    ref_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    ref_rt = DecodeRuntime(w, cfg, slots=1, prefill_chunk=4)
+    ref = ref_rt.generate(ref_prompt, 8, steps_per_window=1)
+    got = engine.generate(ref_prompt, max_new=8).result(60)
+    if not got.ok or list(got.outputs[0]) != ref:
+        sys.exit('serve_soak[decode]: greedy parity broken: engine=%r '
+                 'sequential=%r'
+                 % (list(got.outputs[0]) if got.ok else got.status, ref))
+
+    rt.warmup(steps=K)
+    compiles0 = obs.counters().get('generation.compiles') or 0
+
+    streams, cancellers = [], []
+    overlong = 0
+    period = 1.0 / args.qps if args.qps > 0 else 0.0
+    lengths = (2, 5, 9, 14, 20)
+    for i in range(args.requests):
+        if i % 11 == 10:
+            prompt = list(range(1, 40))        # must be REFUSED, whole
+            overlong += 1
+        else:
+            n = lengths[i % len(lengths)]
+            prompt = [(7 * i + j) % (cfg['vocab'] - 1) + 1
+                      for j in range(n)]
+        s = engine.generate(prompt,
+                            max_new=min(8, cfg['max_len'] - min(
+                                len(prompt), cfg['max_len'] - 1)),
+                            temperature=0.8 if i % 3 else 0.0,
+                            top_k=5 if i % 3 else 0, seed=i,
+                            timeout_s=args.deadline_ms / 1e3)
+        streams.append(s)
+        if args.cancel_every and i % args.cancel_every \
+                == args.cancel_every - 1:
+            def canceller(stream=s):
+                try:
+                    next(stream.tokens(timeout=20.0))
+                except (TimeoutError, StopIteration):
+                    pass
+                stream.cancel()                # mid-stream, after TTFT
+            t = threading.Thread(target=canceller, daemon=True)
+            t.start()
+            cancellers.append(t)
+        if period:
+            time.sleep(period)
+    for t in cancellers:
+        t.join(timeout=30.0)
+    engine.stop()
+
+    statuses, no_reply = {}, 0
+    for s in streams:
+        if not s.done():
+            no_reply += 1
+            continue
+        res = s.result(0)
+        key = (res.status if res.status != 'rejected'
+               else 'rejected.%s' % res.reason)
+        statuses[key] = statuses.get(key, 0) + 1
+
+    tel = obs.telemetry_snapshot('serving')
+    c = obs.counters()
+    compiles_during = (c.get('generation.compiles') or 0) - compiles0
+    rec = {
+        'scenario': 'decode',
+        'requests_submitted': len(streams),
+        'statuses': statuses,
+        'no_reply': no_reply,
+        'cancels_requested': len(cancellers),
+        'overlong_submitted': overlong,
+        'compiles_after_warmup': compiles_during,
+        'mixed_dispatches': int(c.get('generation.mixed_dispatches') or 0),
+        'tokens': int(c.get('generation.tokens') or 0),
+        'free_slots': rt.free_slots(),
+        'state': engine.state,
+    }
+    rec.update(tel)
+    print(json.dumps(rec))
+
+    if args.assert_slo:
+        if no_reply:
+            sys.exit('serve_soak[decode]: %d stream(s) never got a '
+                     'terminal reply' % no_reply)
+        if rec['deadlocks']:
+            sys.exit('serve_soak[decode]: serving.deadlocks=%d'
+                     % rec['deadlocks'])
+        if rec['terminal_replies'] != rec['admitted']:
+            sys.exit('serve_soak[decode]: terminal replies (%d) != '
+                     'admitted (%d)' % (rec['terminal_replies'],
+                                        rec['admitted']))
+        if not statuses.get('ok'):
+            sys.exit('serve_soak[decode]: zero successful streams')
+        for q in ('ttft_p50_ms', 'ttft_p99_ms', 'itl_p50_ms',
+                  'itl_p99_ms'):
+            if rec[q] is None or not np.isfinite(rec[q]):
+                sys.exit('serve_soak[decode]: %s is not finite: %r — '
+                         'token-level SLO histogram unpopulated'
+                         % (q, rec[q]))
+        if rec['mixed_dispatches'] < 1:
+            sys.exit('serve_soak[decode]: no mixed prefill+decode '
+                     'dispatch round observed')
+        if compiles_during:
+            sys.exit('serve_soak[decode]: %d executable compile(s) after '
+                     'warmup — decode loop retraced' % compiles_during)
+        if overlong and not statuses.get('rejected.too_long'):
+            sys.exit('serve_soak[decode]: overlong prompts were not '
+                     'refused as too_long')
+        if len(streams) > len(cancellers) + overlong \
+                and not statuses.get('shed'):
+            sys.exit('serve_soak[decode]: cancellations produced no shed '
+                     'replies')
+        if rec['free_slots'] != rt.slots:
+            sys.exit('serve_soak[decode]: %d/%d KV slots leaked'
+                     % (rt.slots - rec['free_slots'], rt.slots))
+        if rec['state'] != 'stopped':
+            sys.exit('serve_soak[decode]: engine did not reach STOPPED '
+                     '(state=%s)' % rec['state'])
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument('--scenario', default='oneshot',
+                    choices=('oneshot', 'decode'),
+                    help='oneshot: the PR-8 request/reply soak; decode: '
+                         'streaming generation over the KV-cache runtime')
     ap.add_argument('--requests', type=int, default=80,
                     help='open-loop request count')
     ap.add_argument('--qps', type=float, default=120.0,
@@ -130,7 +299,16 @@ def main():
     ap.add_argument('--expect-flight', action='store_true',
                     help='require a flight dump with a serving.batch '
                          'span and a serve_dispatch fault event')
+    ap.add_argument('--slots', type=int, default=4,
+                    help='[decode] KV cache slots')
+    ap.add_argument('--decode-window', type=int, default=4,
+                    help='[decode] tokens per fused decode launch')
+    ap.add_argument('--cancel-every', type=int, default=7,
+                    help='[decode] cancel every Nth stream after its '
+                         'first token (0 = never)')
     args = ap.parse_args()
+    if args.scenario == 'decode':
+        return run_decode_scenario(args)
 
     import numpy as np
     import paddle_tpu.observability as obs
