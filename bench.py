@@ -381,6 +381,11 @@ def main():
               'degraded to its composed path (run PT_STRICT_KERNELS=1 '
               'to get the raw error)' % telemetry['kernel_fallbacks'],
               file=sys.stderr)
+    if telemetry['emitter_fallbacks']:
+        print('BENCH: WARNING — %d emitter fallback(s): the direct '
+              'Program→jaxpr emitter degraded to traced lowering (run '
+              'PT_STRICT_EMIT=1 to get the raw error)'
+              % telemetry['emitter_fallbacks'], file=sys.stderr)
     if telemetry['retraces']:
         print('BENCH: WARNING — %d retrace(s) DURING the measured fused '
               'loop; the number below is compile-polluted'

@@ -21,6 +21,7 @@ Code table (docs/analysis.md has the full semantics):
   D012 warning  numerical hazard: unclipped log/div/exp
   D013 warning  numerical hazard: softmax built without max-subtraction
   D014 warning  degenerate learning-rate decay constant
+  D015 info     op not emit-capable (direct emitter would fall back)
   D099 info     lint pass crashed (analyzer bug, never fatal)
 """
 
@@ -44,6 +45,7 @@ CODES = {
     'D012': 'unclipped log/div/exp',
     'D013': 'softmax without max-subtraction',
     'D014': 'degenerate lr decay',
+    'D015': 'op not emit-capable',
     'D099': 'lint pass crashed',
 }
 

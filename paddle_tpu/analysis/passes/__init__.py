@@ -11,3 +11,4 @@ from . import liveness  # noqa: F401
 from . import aliasing  # noqa: F401
 from . import retrace  # noqa: F401
 from . import numeric  # noqa: F401
+from . import emit_coverage  # noqa: F401

@@ -1,0 +1,53 @@
+"""D015: ops the direct Program→jaxpr emitter cannot lower.
+
+The emitter (core/emit) falls back to classic traced lowering — per
+PROGRAM, not per op — the moment its coverage walk meets one op it has
+no capability for, so a single exotic op silently forfeits the whole
+program's trace-free cold start (warn-once + ``emitter.fallbacks``
+counters at run time).  This pass reports the same gap statically, with
+op locations, using the exact capability test the engine applies
+(``emit.op_capability``), including fused sub-programs whose sub-ops
+must each be replayable.
+
+Severity is info: falling back is correct, just slow — ci_smoke's
+``--all-builtin`` gate holds the zoo to zero D015s so builtin coverage
+regressions surface in CI rather than as cold-start regressions.
+"""
+from ..engine import register_pass
+
+__all__ = ['run']
+
+
+@register_pass('emit_coverage')
+def run(ctx):
+    from ...core.emit import emitter
+    diags = []
+    seen = set()
+    for block in ctx.program.blocks:
+        for i, op in enumerate(block.ops):
+            gaps = []
+            ok, why = emitter.op_capability(op.type)
+            if not ok:
+                gaps.append((op.type, why))
+            elif op.type == 'fused_elementwise':
+                for sub in op.attrs.get('sub_ops', ()):
+                    sok, swhy = emitter.op_capability(sub['type'])
+                    if not sok:
+                        gaps.append((sub['type'],
+                                     swhy + ' (fused sub-op)'))
+            for gap_type, gap_why in gaps:
+                if gap_type in seen:
+                    continue
+                seen.add(gap_type)
+                diags.append(ctx.diag(
+                    'D015', 'info',
+                    'op "%s" is not emit-capable (%s): the direct '
+                    'emitter (PT_EMIT=1) falls back to traced lowering '
+                    'for the WHOLE program, forfeiting its trace-free '
+                    'cold start' % (gap_type, gap_why),
+                    block=block, op=op, op_index=i,
+                    fixit='register the op (registry.register_op) or an '
+                          'emit rule (registry.register_emit), or set '
+                          'PT_EMIT=0 to silence the runtime warning',
+                    pass_name='emit_coverage'))
+    return diags

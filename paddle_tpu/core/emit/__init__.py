@@ -1,0 +1,138 @@
+"""Direct Program→jaxpr emitter: trace-free cold starts.
+
+PR-5's trace/compile split proved cold-start time is dominated by per-op
+``jnp`` primitive dispatch inside the kernels — cutting 57% of program
+ops barely moved ``jit.lower()`` — so this package bypasses per-op
+Python tracing: the optimized Program IR lowers through **memoized,
+signature-keyed jitted op functions** (pjit call eqns in the outer
+jaxpr).  The bench transformer's 232 ops collapse onto ~30 distinct
+(op type, canonical attrs, input avals, AMP mode, demanded outputs)
+signatures, each traced ONCE per process; everything after the first
+occurrence is a cached function application.  Hand raw-``lax`` emit
+rules (rules.py, registered via ``registry.register_emit``) skip kernel
+tracing entirely for the hottest signatures; the kernel stays the
+semantic reference (tests sweep rule vs kernel bitwise).
+
+Env contract:
+
+* ``PT_EMIT=1`` (default) — emit-mode lowering with per-program
+  fallback to the traced path on any unsupported op (loud: warn-once +
+  ``emitter.fallbacks`` counters, mirroring ops/_fallback.py).
+* ``PT_EMIT=0`` — classic traced lowering.
+* ``PT_STRICT_EMIT=1`` — a fallback raises instead, naming the first
+  unsupported op (CI posture; ci_smoke holds all 12 zoo programs to
+  zero fallbacks under it).
+
+Parity is bitwise (losses AND end-of-run param/optimizer state) because
+emission replicates the executor's per-op policies inside each memoized
+function — AMP casts, ``_amp_match_ins``, cast-back, per-sub-op
+stop-gradient — and RNG sites receive their fold-in stream bases as
+*traced arguments*, so ``fold_in(base_key, stream + n)`` matches the
+kernel's ``ctx.rng`` derivation exactly while ops that differ only in
+``rng_stream`` share one compiled signature.
+
+Fingerprint interaction (core/compile_cache): emitted executables join
+the AOT disk cache keyed with ``extra=(EMITTER_VERSION, coverage set)``
+— the per-program set of (op type, rule-or-kernel) emission modes — so
+bumping the emitter or flipping one op between rule and kernel emission
+invalidates exactly the affected entries.  A program that *falls back*
+fingerprints with ``extra=None`` and therefore SHARES disk artifacts
+with ``PT_EMIT=0`` runs.
+"""
+import os
+
+from ... import observability as _obs
+
+__all__ = ['enabled', 'strict', 'config_token', 'EMITTER_VERSION',
+           'EmitFallback', 'EmitError', 'build_engine', 'unsupported_ops',
+           'note_fallback', 'clear_memo', 'reset_fallbacks']
+
+# bump on any change to emission semantics/keying — it joins the AOT
+# disk fingerprint, so stale emitted executables can never be served
+EMITTER_VERSION = 1
+
+
+def enabled():
+    return os.environ.get('PT_EMIT', '1') not in ('0', 'false', 'False')
+
+
+def strict():
+    return os.environ.get('PT_STRICT_EMIT', '0') in ('1', 'true', 'True')
+
+
+def config_token():
+    """Joins the executor hot key and the launch signature's ``emit``
+    component: toggling PT_EMIT mid-process must read as a NAMED
+    signature change (same pattern as the PT_OPT config token)."""
+    return ('emit', 1 if enabled() else 0, EMITTER_VERSION)
+
+
+class EmitFallback(Exception):
+    """Static coverage gap found while building the engine: the program
+    contains an op the emitter cannot lower.  Non-strict mode catches
+    this per program and falls back to traced lowering."""
+
+    def __init__(self, op, why):
+        self.op = op
+        self.why = why
+        super(EmitFallback, self).__init__(
+            'op "%s" is not emit-capable: %s' % (op, why))
+
+
+class EmitError(Exception):
+    """Runtime emission failure (raised mid-trace), e.g. an op outside
+    the known RNG set drew from ``ctx.rng``.  The executor catches it,
+    notes the fallback, and rebuilds the program on the traced path."""
+
+    def __init__(self, op, why):
+        self.op = op
+        self.why = why
+        super(EmitError, self).__init__(
+            'emitting op "%s" failed: %s' % (op, why))
+
+
+# ------------------------------------------------- loud degradation
+# mirrors ops/_fallback.py kernel_fallback: silent degradation is how
+# perf regressions hide — every program-level fallback warns ONCE per
+# op type and bumps counters bench telemetry gates on
+_warned = set()
+
+
+def note_fallback(op, why):
+    import warnings
+    _obs.metrics.counter('emitter.fallbacks').inc()
+    _obs.metrics.counter('emitter.fallbacks.%s' % op).inc()
+    if _obs.enabled():
+        _obs.tracing.instant('emitter.fallback', cat='compile',
+                             args={'op': op, 'why': str(why)[:256]})
+    if op not in _warned:
+        _warned.add(op)
+        warnings.warn(
+            'direct emitter fell back to traced lowering on op "%s": %s '
+            '(PT_STRICT_EMIT=1 raises instead; PT_EMIT=0 silences)'
+            % (op, why), RuntimeWarning, stacklevel=3)
+
+
+def reset_fallbacks():
+    """Test hook: forget the warn-once set."""
+    _warned.clear()
+
+
+def build_engine(program, feed_names, fetch_names):
+    """Static coverage walk + demanded-output analysis for one optimized
+    program.  Raises EmitFallback on the first unsupported op."""
+    from . import emitter
+    return emitter.EmitEngine(program, feed_names, fetch_names)
+
+
+def unsupported_ops(program):
+    """[(op_type, why)] over all blocks — the static gap list pt_lint's
+    D015 pass renders (same capability test the engine applies)."""
+    from . import emitter
+    return emitter.unsupported_ops(program)
+
+
+def clear_memo():
+    """Test hook: drop the process-wide memoized op functions."""
+    from . import emitter
+    emitter.clear_memo()

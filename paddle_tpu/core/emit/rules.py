@@ -1,0 +1,88 @@
+"""Hand raw-``lax`` emit rules for the hottest signatures.
+
+Per-signature build profiling on the bench transformer (PERF.md) put
+~75% of memo-build time in tracing kernel impls through ``jnp`` — the
+Adam ``fused_elementwise`` group (158 sub-ops) alone cost 0.9s.  These
+rules mirror each kernel's primitive DAG directly in ``lax``, skipping
+``jnp``'s dispatch/promotion layers: same DAG → same XLA program → same
+bits (IEEE ops are commutative in operand *naming*, not evaluation
+order — the order here matches the kernel exactly).
+
+Rules are a PERF OVERLAY, not a second semantics: every rule is swept
+against its kernel bitwise in tests/test_emitter.py, and the emitter's
+coverage set marks rule-vs-kernel emission per op type in the AOT
+fingerprint.  Guidelines for adding one:
+
+* mirror the kernel line-for-line; ``jnp.square`` is
+  ``lax.integer_pow(x, 2)``; use ``jnp.multiply`` (not ``lax.mul``)
+  where operand ranks may differ (lax requires equal shapes);
+* scalar python-float operands promote identically under lax and jnp;
+* elementwise rules take the lax fast path only on exact shape+dtype
+  match and defer to the kernel's ``jnp`` expression otherwise;
+* ops built on ``custom_jvp``/``custom_vjp`` wrappers (relu, the
+  attention kernels) keep their kernels — the wrapper IS the fast path.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import get_op, register_emit
+from ...ops.math import _bcast_y
+
+__all__ = []
+
+
+@register_emit('adam')
+def adam(ctx, ins, attrs):
+    p, g = ins['Param'], ins['Grad']
+    m1, m2 = ins['Moment1'], ins['Moment2']
+    b1p, b2p = ins['Beta1Pow'], ins['Beta2Pow']
+    if not (p.dtype == g.dtype == m1.dtype == m2.dtype):
+        # mixed precision (bf16 grads over f32 moments): lax requires
+        # equal dtypes where the kernel's jnp ops promote — defer
+        return get_op('adam').impl(ctx, ins, attrs)
+    b1 = attrs.get('beta1', 0.9)
+    b2 = attrs.get('beta2', 0.999)
+    eps = attrs.get('epsilon', 1e-8)
+    lr = lax.reshape(ins['LearningRate'], ())
+    m1n = lax.add(lax.mul(b1, m1), lax.mul(1 - b1, g))
+    m2n = lax.add(lax.mul(b2, m2), lax.mul(1 - b2, lax.integer_pow(g, 2)))
+    lr_t = lax.div(
+        lax.mul(lr, lax.sqrt(lax.sub(1.0, lax.reshape(b2p, ())))),
+        lax.sub(1.0, lax.reshape(b1p, ())))
+    pn = lax.sub(p, lax.div(jnp.multiply(lr_t, m1n),
+                            lax.add(lax.sqrt(m2n), eps)))
+    return {'ParamOut': pn, 'Moment1Out': m1n, 'Moment2Out': m2n,
+            'Beta1PowOut': lax.mul(b1p, b1),
+            'Beta2PowOut': lax.mul(b2p, b2)}
+
+
+@register_emit('reshape')
+def reshape(ctx, ins, attrs):
+    x = ins['X']
+    out_shape = [x.shape[i] if d == 0 else int(d)
+                 for i, d in enumerate(attrs['shape'])]
+    return {'Out': x.reshape(out_shape), 'XShape': None}
+
+
+@register_emit('transpose')
+def transpose(ctx, ins, attrs):
+    return {'Out': lax.transpose(ins['X'], tuple(attrs['axis'])),
+            'XShape': None}
+
+
+def _ew_rule(name, lax_fn, jnp_fn):
+    @register_emit(name)
+    def rule(ctx, ins, attrs, _lax=lax_fn, _jnp=jnp_fn):
+        x, y = ins['X'], ins['Y']
+        y = _bcast_y(x, y, attrs.get('axis', -1))
+        if getattr(x, 'shape', None) == getattr(y, 'shape', ()) and \
+                getattr(x, 'dtype', 0) == getattr(y, 'dtype', 1):
+            return {'Out': _lax(x, y)}
+        return {'Out': _jnp(x, y)}
+    return rule
+
+
+_ew_rule('elementwise_add', lax.add, lambda x, y: x + y)
+_ew_rule('elementwise_sub', lax.sub, lambda x, y: x - y)
+_ew_rule('elementwise_mul', lax.mul, lambda x, y: x * y)
+_ew_rule('elementwise_div', lax.div, lambda x, y: x / y)

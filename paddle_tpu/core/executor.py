@@ -15,6 +15,7 @@ import numpy as np
 
 from . import registry
 from . import compile_cache as _cc
+from . import emit as _emit
 from . import passes as _passes
 from .framework import (Variable, default_main_program, TPUPlace,
                         Program)
@@ -240,11 +241,19 @@ def _exec_ops_plain(ops, op_offset, env, ectx, program):
     import jax.lax as lax
     import jax.numpy as jnp
     amp = getattr(program, '_amp', False)
+    # direct-emit mode (core/emit): _lower attached an EmitEngine to the
+    # ExecCtx — ops lower through memoized per-signature functions
+    # instead of per-op kernel tracing.  Control flow stays native (its
+    # bodies re-enter here, engine in tow).
+    engine = getattr(ectx, 'emit_engine', None)
     for i, op in enumerate(ops):
         if op.type in _CONTROL_FLOW:
             from . import control_flow_exec
             control_flow_exec.exec_control_flow_op(
                 op, env, ectx, op_offset + i, program)
+            continue
+        if engine is not None:
+            engine.run_op(op, op_offset + i, env, ectx)
             continue
         impl = registry.get_op(op.type).impl
         use_amp = amp and op.type in _AMP_OPS
@@ -359,11 +368,13 @@ def _launch_signature(program, feed_vals, feed_names, fetch_names, steps,
                                     type(feed_vals[n]).__name__))
                      for n in feed_names},
         fetch_set=fetch_names, steps=steps, check_nan=check_nan,
-        scope=scope._serial, opt=_passes.config_token())
+        scope=scope._serial, opt=_passes.config_token(),
+        emit=_emit.config_token())
 
 
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
-           out_shardings_for=None, check_nan=False, steps=None):
+           out_shardings_for=None, check_nan=False, steps=None,
+           emit_engine=None):
     """Build the jitted step function for (program, feeds, fetches).
     check_nan compiles a fused all-finite flag over fetches+updates INTO
     the executable (per-array host checks measured >30x slower through
@@ -414,6 +425,8 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
             jax.random.key(program.random_seed), counter)
         ectx = registry.ExecCtx(base_key, mesh=mesh,
                                 amp=getattr(program, '_amp', False))
+        if emit_engine is not None:
+            ectx.emit_engine = emit_engine
         env0 = {}
         env0.update(feeds)
         env0.update(params)
@@ -439,19 +452,27 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
             # ledger (PERF.md r5): unused auxiliary outputs (op Softmax
             # slots, norm statistics) kept whole [B, T, V]-scale
             # forward+backward chains alive.
-            fw_keep = set(fetch_names) | set(writeback) | {loss_name}
+            if emit_engine is not None and \
+                    emit_engine.slim_fw_keep is not None:
+                # emit mode: the engine's keep-set additionally excludes
+                # post-backward reads that are (re)written before the
+                # read and names the forward never computes — fewer vjp
+                # primal outputs means fewer dense zero cotangents
+                fw_keep = set(emit_engine.slim_fw_keep)
+            else:
+                fw_keep = set(fetch_names) | set(writeback) | {loss_name}
 
-            def _collect_reads(op_list):
-                for op_after in op_list:
-                    fw_keep.update(op_after.input_names())
-                    # control-flow bodies read outer vars directly from
-                    # env (not through input slots) — recurse like
-                    # _analyze does
-                    sb = op_after.attrs.get('sub_block')
-                    if sb is not None:
-                        _collect_reads(program.block(sb).ops)
+                def _collect_reads(op_list):
+                    for op_after in op_list:
+                        fw_keep.update(op_after.input_names())
+                        # control-flow bodies read outer vars directly
+                        # from env (not through input slots) — recurse
+                        # like _analyze does
+                        sb = op_after.attrs.get('sub_block')
+                        if sb is not None:
+                            _collect_reads(program.block(sb).ops)
 
-            _collect_reads(ops[bw_idx + 1:])
+                _collect_reads(ops[bw_idx + 1:])
 
             def fw(d):
                 env2 = dict(rest)
@@ -467,7 +488,16 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                       else _zero_cotangent(v))
                   for k, v in env_out.items()}
             grads, = pullback(ct)
-            env = dict(env_out)
+            if emit_engine is not None and \
+                    emit_engine.slim_fw_keep is not None:
+                # the slim keep-set drops pass-through names (params the
+                # optimizer reads but the forward never writes) from the
+                # vjp primal outputs; post-backward ops read them from
+                # the original environment instead
+                env = dict(env0)
+                env.update(env_out)
+            else:
+                env = dict(env_out)
             for slot, names in bw_op.outputs.items():
                 if slot == 'Grads':
                     for p, gname in zip(pnames, names):
@@ -823,7 +853,7 @@ class Executor(object):
                 tuple((n,) + _feed_spec(feed_vals[n])
                       for n in sorted(feed_vals)),
                 fetch_names, self.check_nan, steps,
-                _passes.config_token())
+                _passes.config_token(), _emit.config_token())
 
     def _gather_params(self, program, params_in, scope, base_key):
         import jax
@@ -900,10 +930,28 @@ class Executor(object):
                 args=dict(self._obs_tags,
                           raw=opt_stats['op_count_raw'],
                           opt=opt_stats['op_count_opt']) or None)
+        # Direct Program->jaxpr emitter (core/emit): built on the
+        # optimized twin so emission sees the fused/rng_stream-stamped
+        # shape.  A static coverage gap falls back PER PROGRAM to the
+        # traced path — loudly (emitter.fallbacks counters, warn-once,
+        # PT_STRICT_EMIT=1 raises naming the op).  The cache-bypass path
+        # (use_cache=False) keeps seed semantics and never emits.
+        engine, emit_verdict = None, 'trace'
+        if use_cache and _emit.enabled():
+            try:
+                engine = _emit.build_engine(opt_program, feed_names,
+                                            fetch_names)
+                emit_verdict = 'emit'
+            except _emit.EmitFallback as e:
+                if _emit.strict():
+                    raise
+                _emit.note_fallback(e.op, e.why)
+                emit_verdict = 'emit_fallback:%s' % e.op
         t_l0 = time.perf_counter() if obs_on else None
         jit_fn, params_in, writeback = _lower(
             opt_program, feed_names, fetch_names, donate=True,
-            mesh=self.mesh, check_nan=self.check_nan, steps=steps)
+            mesh=self.mesh, check_nan=self.check_nan, steps=steps,
+            emit_engine=engine)
         if obs_on:
             _obs.metrics.counter('executor.lowerings').inc()
             _obs.tracing.add_span(
@@ -922,11 +970,16 @@ class Executor(object):
             # fingerprint the OPTIMIZED desc: it is what actually lowers,
             # and it folds the PT_OPT config in for free (PT_OPT=0 hashes
             # the raw desc, a skipped pass changes the rewrite output)
+            # emit-mode entries carry the emitter version + coverage set
+            # in the key; fallback (and PT_EMIT=0) entries use extra=None
+            # so traced artifacts are SHARED across modes on disk
             fp = _cc.launch_fingerprint(
                 opt_program,
                 {n: _feed_spec(feed_vals[n]) for n in feed_names},
                 fetch_names, steps, self.check_nan, mesh=self.mesh,
-                param_specs={n: _feed_spec(v) for n, v in params.items()})
+                param_specs={n: _feed_spec(v) for n, v in params.items()},
+                extra=engine.fingerprint_extra() if engine is not None
+                else None)
             t_a0 = time.perf_counter()
             call, disk_tier = _cc.disk_cache().load(fp)
             if obs_on:
@@ -947,18 +1000,49 @@ class Executor(object):
                     _obs.metrics.counter('compile_cache.disk_misses').inc()
         if call is None:
             tc0 = _TRACE_COUNT[0]
+            args = (params, {n: feed_vals[n] for n in feed_names},
+                    np.uint32(counter & 0xffffffff))
             t_c0 = time.perf_counter()
-            lowered = jit_fn.lower(params,
-                                   {n: feed_vals[n] for n in feed_names},
-                                   np.uint32(counter & 0xffffffff))
+            try:
+                traced = jit_fn.trace(*args)
+            except _emit.EmitError as e:
+                # runtime emission gap (e.g. an op outside the known RNG
+                # set drew ctx.rng): rebuild this program on the traced
+                # path.  The fingerprint is recomputed with extra=None so
+                # the stored artifact is the shared traced one.
+                if engine is None or _emit.strict():
+                    raise
+                _emit.note_fallback(e.op, e.why)
+                emit_verdict = 'emit_fallback:%s' % e.op
+                engine = None
+                jit_fn, params_in, writeback = _lower(
+                    opt_program, feed_names, fetch_names, donate=True,
+                    mesh=self.mesh, check_nan=self.check_nan,
+                    steps=steps)
+                if fp is not None:
+                    fp = _cc.launch_fingerprint(
+                        opt_program,
+                        {n: _feed_spec(feed_vals[n]) for n in feed_names},
+                        fetch_names, steps, self.check_nan,
+                        mesh=self.mesh,
+                        param_specs={n: _feed_spec(v)
+                                     for n, v in params.items()})
+                traced = jit_fn.trace(*args)
             t_cmid = time.perf_counter()
+            lowered = traced.lower()
             call = lowered.compile()
             t_c1 = time.perf_counter()
+            # emit_s: wall time inside the emitter (memo build +
+            # dispatch); trace_s: the residual jaxpr-staging time.  With
+            # the staged AOT API the StableHLO lowering now lands in
+            # backend_compile_s for BOTH modes (accounting change vs
+            # PR-5, documented in PERF.md).
+            emit_s = engine.take_build_seconds() if engine is not None \
+                else 0.0
             if obs_on:
-                # the trace/compile split: Python tracing (what PT_OPT
-                # shrinks) vs the XLA backend compile underneath it
+                _obs.metrics.counter('executor.emit_s').inc(emit_s)
                 _obs.metrics.counter('executor.trace_s').inc(
-                    t_cmid - t_c0)
+                    max(0.0, (t_cmid - t_c0) - emit_s))
                 _obs.metrics.counter('executor.backend_compile_s').inc(
                     t_c1 - t_cmid)
             if obs_on and _TRACE_COUNT[0] > tc0:
@@ -969,11 +1053,13 @@ class Executor(object):
                                 'stablehlo_hit' if disk_tier == 'stablehlo'
                                 else 'miss')
                 report = _obs.explainer().observe(
-                    sig, compile_s=t_c1 - t_c0, cache=cache_status)
+                    sig, compile_s=t_c1 - t_c0, cache=cache_status,
+                    lowering=emit_verdict)
                 _obs.tracing.add_span(
                     'executor.trace_compile', t_c0, t_c1, cat='compile',
                     args=dict(self._obs_tags, steps=steps,
                               kind=report['kind'],
+                              lowering=emit_verdict,
                               cause='; '.join(report['details'])[:512]
                               or None))
             if fp is not None:

@@ -15,19 +15,28 @@ Impl signature::
 
 `ctx.rng()` returns a fresh PRNG key (derived from the run seed and the op's
 position in the block, so every op — and every run — gets distinct streams).
+
+An op may ALSO carry an **emit rule** (`register_emit`): a raw-`lax`
+fast path the direct Program→jaxpr emitter (core/emit) uses instead of
+tracing the kernel when building its memoized per-signature functions.
+The kernel stays the semantic reference — tests/test_emitter.py sweeps
+every emit rule against its kernel for bitwise parity.
 """
 import jax
 
 _REGISTRY = {}
 
-__all__ = ['register', 'has_op', 'get_op', 'op_names', 'OpDef', 'InferCtx',
-           'ExecCtx']
+__all__ = ['register', 'register_emit', 'has_op', 'get_op', 'op_names',
+           'OpDef', 'InferCtx', 'ExecCtx']
 
 
 class OpDef(object):
     def __init__(self, name, impl):
         self.name = name
         self.impl = impl
+        # optional raw-lax emit rule (same (ctx, ins, attrs) signature);
+        # None means the emitter traces the kernel impl instead
+        self.emit = None
 
 
 def register(name):
@@ -35,6 +44,22 @@ def register(name):
         if name in _REGISTRY:
             raise ValueError('op %s already registered' % name)
         _REGISTRY[name] = OpDef(name, fn)
+        return fn
+    return deco
+
+
+def register_emit(name):
+    """Attach a direct-emit rule to an already-registered op.  Rules are
+    a perf overlay: they must be bitwise-identical to the kernel (the
+    emitter's coverage set distinguishes rule vs kernel emission in the
+    AOT fingerprint, so editing one invalidates only its own entries)."""
+    def deco(fn):
+        od = _REGISTRY.get(name)
+        if od is None:
+            raise ValueError('emit rule for unregistered op %s' % name)
+        if od.emit is not None:
+            raise ValueError('emit rule for %s already registered' % name)
+        od.emit = fn
         return fn
     return deco
 

@@ -99,6 +99,7 @@ SCHEMA = {
         ('compile_cache_hits', ('int', 'compile_cache.disk_hits')),
         ('compile_cache_misses', ('int', 'compile_cache.disk_misses')),
         ('tail_splits', ('int', 'executor.tail_splits')),
+        ('emit_s', ('sec', 'executor.emit_s')),
         ('trace_s', ('sec', 'executor.trace_s')),
         ('backend_compile_s', ('sec', 'executor.backend_compile_s')),
         ('program_op_count_raw', ('extra',)),
@@ -109,6 +110,7 @@ SCHEMA = {
         ('prefetch_starvation_s', ('sec', 'prefetch.starvation_s')),
         ('fetch_sync_s', ('sec', 'executor.fetch_sync_s')),
         ('kernel_fallbacks', ('int', 'kernel.fallbacks')),
+        ('emitter_fallbacks', ('int', 'emitter.fallbacks')),
     ),
     'serving': (
         ('admitted', ('int', 'serving.admitted')),

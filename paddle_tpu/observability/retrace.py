@@ -26,7 +26,7 @@ from . import tracing
 __all__ = ['LaunchSignature', 'RetraceExplainer', 'explainer', 'reset']
 
 _COMPONENTS = ('program', 'feed_shapes', 'feed_dtypes', 'fetch_set',
-               'steps', 'check_nan', 'scope', 'opt')
+               'steps', 'check_nan', 'scope', 'opt', 'emit')
 
 
 class LaunchSignature(object):
@@ -34,11 +34,13 @@ class LaunchSignature(object):
     lowering cache (and jax.jit underneath it) keys on.  `opt` is the
     program-rewriter config token (core/passes.config_token()): toggling
     PT_OPT / PT_OPT_SKIP mid-process changes what the tracer sees for the
-    same raw program, and must be named, not a mystery retrace."""
+    same raw program, and must be named, not a mystery retrace.  `emit`
+    is the direct-emitter token (core/emit.config_token()) — flipping
+    PT_EMIT is likewise a named signature change."""
     __slots__ = _COMPONENTS
 
     def __init__(self, program, feed_shapes, feed_dtypes, fetch_set,
-                 steps, check_nan, scope, opt=None):
+                 steps, check_nan, scope, opt=None, emit=None):
         self.program = program            # (serial, version)
         self.feed_shapes = dict(feed_shapes)   # name -> tuple
         self.feed_dtypes = dict(feed_dtypes)   # name -> str
@@ -47,6 +49,7 @@ class LaunchSignature(object):
         self.check_nan = bool(check_nan)
         self.scope = scope
         self.opt = opt
+        self.emit = emit
 
     def changed_components(self, other):
         return [c for c in _COMPONENTS
@@ -88,6 +91,10 @@ class LaunchSignature(object):
         if self.opt != other.opt:
             details.append('opt: PT_OPT config %r -> %r (program rewriter '
                            'toggled/reconfigured)' % (other.opt, self.opt))
+        if self.emit != other.emit:
+            details.append('emit: PT_EMIT config %r -> %r (direct '
+                           'emitter toggled or versioned)'
+                           % (other.emit, self.emit))
         return details
 
 
@@ -116,11 +123,15 @@ class RetraceExplainer(object):
         self._seen = []
         self.reports = deque(maxlen=max_reports)
 
-    def observe(self, sig, compile_s=0.0, label=None, cache=None):
+    def observe(self, sig, compile_s=0.0, label=None, cache=None,
+                lowering=None):
         """Record one (re)trace; returns the report dict.  `cache` names
         the disk-cache verdict for this trace ('miss' / 'stablehlo_hit' /
         'disabled') so every retrace is annotated with whether the
-        persistent tier could have prevented it."""
+        persistent tier could have prevented it.  `lowering` names HOW
+        the program lowered: 'emit' (direct emitter), 'trace' (classic
+        per-op tracing), or 'emit_fallback:<op>' (the emitter hit that
+        op and this program degraded to tracing)."""
         with self._lock:
             if not self._seen:
                 kind, changed, details = 'initial_compile', [], []
@@ -148,7 +159,8 @@ class RetraceExplainer(object):
                     'this feed onto an existing bucket signature')
             self._seen.append(sig)
         report = {'kind': kind, 'changed': changed, 'details': details,
-                  'compile_s': compile_s, 'label': label, 'cache': cache}
+                  'compile_s': compile_s, 'label': label, 'cache': cache,
+                  'lowering': lowering}
         self.reports.append(report)
         if kind == 'retrace':
             metrics.counter('executor.retraces').inc()
@@ -181,10 +193,12 @@ class RetraceExplainer(object):
         report = report or self.last_report()
         if report is None:
             return '<no traces recorded>'
-        lines = ['[%s] compile_s=%.3f%s%s'
+        lines = ['[%s] compile_s=%.3f%s%s%s'
                  % (report['kind'], report['compile_s'],
                     ' cache=%s' % report['cache']
                     if report.get('cache') else '',
+                    ' lowering=%s' % report['lowering']
+                    if report.get('lowering') else '',
                     ' label=%s' % report['label'] if report['label']
                     else '')]
         for d in report['details']:

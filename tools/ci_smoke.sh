@@ -93,6 +93,84 @@ if [ "$opt_gate_rc" -ne 0 ]; then
     echo "ci_smoke: opt pipeline gate FAILED (rc=$opt_gate_rc)"
 fi
 
+echo "== ci_smoke: strict-emit zoo coverage =="
+# direct-emitter gate, part 1 (docs/emitter.md): every zoo program must
+# be fully emit-capable — zero D015 lint findings, an EmitEngine builds
+# without fallback under PT_STRICT_EMIT=1, and (dense-feed models) the
+# whole training program jit-TRACES through the emitter with synthesized
+# params/feeds — runtime emission exercised, no backend compile paid.
+# One op losing its emit rule or a new builtin op landing without one
+# fails here, not as a silent cold-start regression.
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_EMIT=1 PT_STRICT_EMIT=1 \
+    PT_CACHE=0 python - <<'EOF'
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, 'tools')
+import pt_lint  # noqa: E402
+
+from paddle_tpu.core import emit, passes  # noqa: E402
+from paddle_tpu.core import executor as ptex  # noqa: E402
+
+fails = []
+for name in pt_lint.builtin_names():
+    prog, feeds, fetches = pt_lint._zoo_entry(name)()
+    res = prog.lint(feed_names=feeds, fetch_list=fetches)
+    d15 = [d for d in res if d.code == 'D015']
+    if d15:
+        fails.append((name, d15[0].render()))
+        continue
+    opt_prog, _ = passes.maybe_optimize(prog, tuple(fetches))
+    try:
+        engine = emit.build_engine(opt_prog, feeds, fetches)
+    except emit.EmitFallback as e:
+        fails.append((name, 'EmitFallback: %s' % e))
+        continue
+    block = prog.global_block()
+    if any(getattr(block.vars[f], 'lod_level', 0) for f in feeds):
+        print('ci_smoke: %-14s emit-capable (%d op sigs; LoD feeds -> '
+              'static coverage only)' % (name, len(engine.coverage)))
+        continue
+    rng = np.random.RandomState(0)
+    feed_vals = {}
+    for f in feeds:
+        v = block.vars[f]
+        shape = tuple(2 if d in (-1, None) else int(d) for d in v.shape)
+        dt = np.dtype(v.dtype)
+        feed_vals[f] = (np.zeros(shape, dt) if dt.kind in 'iub'
+                        else rng.standard_normal(shape).astype(dt))
+    jit_fn, params_in, _ = ptex._lower(
+        opt_prog, feeds, fetches, donate=False, check_nan=False,
+        emit_engine=engine)
+    params = {}
+    for pn in params_in:
+        v = block.vars[pn]
+        params[pn] = np.zeros(tuple(int(d) for d in v.shape),
+                              np.dtype(v.dtype))
+    t0 = time.perf_counter()
+    try:
+        jit_fn.trace(params, feed_vals, np.uint32(0))
+    except (emit.EmitError, emit.EmitFallback) as e:
+        fails.append((name, 'trace-time: %s' % e))
+        continue
+    print('ci_smoke: %-14s traced under strict emit (%d op sigs, %.1fs)'
+          % (name, len(engine.coverage), time.perf_counter() - t0))
+if fails:
+    for name, why in fails:
+        print('ci_smoke: STRICT-EMIT GAP in %s: %s' % (name, why))
+    sys.exit('ci_smoke: %d zoo program(s) not fully emit-capable'
+             % len(fails))
+print('ci_smoke: all %d zoo programs emit with zero fallbacks '
+      'under PT_STRICT_EMIT=1' % len(pt_lint.builtin_names()))
+EOF
+emit_zoo_rc=$?
+if [ "$emit_zoo_rc" -ne 0 ]; then
+    echo "ci_smoke: strict-emit zoo gate FAILED (rc=$emit_zoo_rc)"
+fi
+
 echo "== ci_smoke: ruff =="
 # style/bug gate with the committed ruff.toml; the container image may
 # not ship ruff — skip with a notice rather than fail the smoke
@@ -283,10 +361,11 @@ tel = rec['telemetry']
 tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'compiles', 'compile_s', 'compile_s_cold', 'compile_s_warm',
                 'compile_cache_hits', 'compile_cache_misses', 'tail_splits',
-                'trace_s', 'backend_compile_s', 'program_op_count_raw',
-                'program_op_count_opt', 'opt_pass_ms', 'opt_ops_fused',
-                'stall_count', 'prefetch_starvation_s', 'fetch_sync_s',
-                'kernel_fallbacks']
+                'emit_s', 'trace_s', 'backend_compile_s',
+                'program_op_count_raw', 'program_op_count_opt',
+                'opt_pass_ms', 'opt_ops_fused', 'stall_count',
+                'prefetch_starvation_s', 'fetch_sync_s',
+                'kernel_fallbacks', 'emitter_fallbacks']
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
     sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
@@ -321,6 +400,12 @@ if tel['kernel_fallbacks'] > 0:
     sys.exit('ci_smoke: %d kernel fallback(s) — a pallas kernel silently '
              'degraded to its composed path (PT_STRICT_KERNELS=1 shows '
              'the raw error)' % tel['kernel_fallbacks'])
+for label, t in (('cold', tel), ('warm', rec2['telemetry'])):
+    if t['emitter_fallbacks'] > 0:
+        sys.exit('ci_smoke: %s bench reports %d emitter fallback(s) — the '
+                 'direct emitter degraded a bench program to traced '
+                 'lowering (PT_STRICT_EMIT=1 shows the raw error)'
+                 % (label, t['emitter_fallbacks']))
 if tel['compiles'] < 1:
     sys.exit('ci_smoke: telemetry.compiles=%r — executor instrumentation '
              'recorded no compiles at all' % tel['compiles'])
@@ -344,6 +429,20 @@ if not tel2['compile_s'] < 0.5 * max(tel['compile_s'], 1e-9):
     sys.exit('ci_smoke: warm compile_s=%.3f did not drop vs cold=%.3f — '
              'warm start is not actually skipping compilation'
              % (tel2['compile_s'], tel['compile_s']))
+# direct-emitter gate, part 2: PT_EMIT=1 is the bench default, so the
+# cold run must show emitter seconds (the emitter actually engaged) and
+# the warm fresh process must serve emitted executables from disk —
+# emit_s + trace_s collapsing alongside compile_s proves the AOT cache
+# keys emitted artifacts correctly (fingerprint extra=emitter coverage)
+cold_front = tel['emit_s'] + tel['trace_s']
+warm_front = tel2['emit_s'] + tel2['trace_s']
+if not tel['emit_s'] > 0:
+    sys.exit('ci_smoke: cold bench emit_s=%r — PT_EMIT=1 is the default '
+             'but the direct emitter never engaged' % tel['emit_s'])
+if not warm_front < 0.5 * max(cold_front, 1e-9):
+    sys.exit('ci_smoke: warm emit_s+trace_s=%.3f did not collapse vs '
+             'cold=%.3f — emitted executables are not round-tripping '
+             'the persistent cache' % (warm_front, cold_front))
 print('ci_smoke: bench JSON schema ok (%d keys, steps_per_launch=%d, '
       'platform=%s, retraces=%d after warmup)'
       % (len(rec), rec['steps_per_launch'], tel['platform'],
@@ -360,6 +459,7 @@ if [ "$t1_rc" -ne 0 ]; then
 fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
-    [ "$opt_gate_rc" -eq 0 ] && [ "$soak_rc" -eq 0 ] && \
+    [ "$opt_gate_rc" -eq 0 ] && [ "$emit_zoo_rc" -eq 0 ] && \
+    [ "$soak_rc" -eq 0 ] && \
     [ "$resume_rc" -eq 0 ] && [ "$pod_rc" -eq 0 ] && \
     [ "$serve_rc" -eq 0 ] && [ "$decode_rc" -eq 0 ]
