@@ -312,8 +312,8 @@ def main():
                             fetch_list=[out['loss']],
                             return_numpy=False)
         np.asarray(loss)  # block
-        dt = time.perf_counter() - t0
-        tps_single = steps * tokens_per_step / dt
+        dt_single = time.perf_counter() - t0
+        tps_single = steps * tokens_per_step / dt_single
 
         # multi-step fused loop (the headline): K iterations per device
         # launch via run_steps — one lax.scan executable amortizes the
@@ -348,6 +348,37 @@ def main():
         exe.run_steps(main_prog, feed_list=tailfeed, steps=1,
                       fetch_list=[out['loss']], return_numpy=False)
         snap1 = obs.counters()
+
+        # sync-mode comparison row: the SAME fused launches but with a
+        # host fetch (return_numpy=True) after every one — what the
+        # headline number would be if the host serialized the device
+        stage('sync_compare')
+        t0 = time.perf_counter()
+        for _ in range(launches):
+            exe.run_steps(main_prog, feed_list=superfeed, steps=K,
+                          fetch_list=[out['loss']], return_numpy=True)
+        dt_sync = time.perf_counter() - t0
+        tps_sync = launches * K * tokens_per_step / dt_sync
+
+        # deferred check_nan overhead: with nan_poll=8 the fused
+        # all-finite verdict stays device-resident between polls, so the
+        # guard should cost ~nothing vs the unguarded single-step loop
+        # (PERF.md's old per-launch bool() sync made it ~4x)
+        stage('check_nan')
+        exe_nan = fluid.Executor(check_nan=True, nan_poll=8)
+        for _ in range(2):  # compile + warmup for the guarded executable
+            loss, = exe_nan.run(main_prog, feed=feed,
+                                fetch_list=[out['loss']])
+        exe_nan.poll_nan()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, = exe_nan.run(main_prog, feed=feed,
+                                fetch_list=[out['loss']],
+                                return_numpy=False)
+        exe_nan.poll_nan()
+        np.asarray(loss)  # block
+        dt_nan = time.perf_counter() - t0
+        check_nan_overhead_x = dt_nan / dt_single
 
     tps = launches * K * tokens_per_step / dt
 
@@ -435,6 +466,8 @@ def main():
         'batch': B, 'seq': T, 'amp': True, 'flash': True,
         'steps_per_launch': K,
         'single_step_tokens_per_sec': round(tps_single, 1),
+        'sync_mode_tokens_per_sec': round(tps_sync, 1),
+        'check_nan_overhead_x': round(check_nan_overhead_x, 2),
         'telemetry': telemetry,
     }
     rec.update(resnet_rec)

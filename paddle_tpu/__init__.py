@@ -20,6 +20,7 @@ from .core.framework import (  # noqa
     name_scope, CPUPlace, CUDAPlace, TPUPlace, CUDAPinnedPlace, cpu_places,
     cuda_places, tpu_places, is_compiled_with_cuda, get_flags, set_flags)
 from .core.executor import Executor, Scope, scope_guard, global_scope  # noqa
+from .core.async_runtime import FetchFuture  # noqa
 from .core.backward import append_backward, gradients, calc_gradient  # noqa
 from .core import unique_name  # noqa
 from .core.lod import (LoDTensor, create_lod_tensor,  # noqa

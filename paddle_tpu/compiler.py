@@ -120,24 +120,28 @@ class CompiledProgram(_CompiledProgramBase):
                 build_strategy=self._build_strategy)
         return self._pe
 
-    def _run(self, exe, feed, fetch_list, scope, return_numpy):
+    def _run(self, exe, feed, fetch_list, scope, return_numpy,
+             as_futures=False):
         k = self._steps_per_launch
         if k > 1 and isinstance(feed, (list, tuple)):
             # num_iteration_per_drop_scope > 1 + a list of per-step feeds:
             # run the whole list K iterations per device launch and return
             # the per-step fetches stacked over ALL steps
             return self._run_steps(exe, list(feed), fetch_list, None,
-                                   scope, return_numpy)
+                                   scope, return_numpy,
+                                   as_futures=as_futures)
         if not self._data_parallel:
             return exe.run(self._program, feed=feed, fetch_list=fetch_list,
-                           scope=scope, return_numpy=return_numpy)
+                           scope=scope, return_numpy=return_numpy,
+                           as_futures=as_futures)
         fetch_names = [f if isinstance(f, str) else f.name
                        for f in (fetch_list or [])]
         return self._pe_for(exe).run(fetch_names, feed=feed,
-                                     return_numpy=return_numpy)
+                                     return_numpy=return_numpy,
+                                     as_futures=as_futures)
 
     def _run_steps(self, exe, feed_list, fetch_list, steps, scope,
-                   return_numpy):
+                   return_numpy, as_futures=False):
         """K-iterations-per-launch execution: chunk the per-step feeds by
         num_iteration_per_drop_scope and fuse each chunk into one launch."""
         k = steps or self._steps_per_launch
@@ -150,7 +154,8 @@ class CompiledProgram(_CompiledProgramBase):
         if isinstance(feed_list, dict):   # pre-stacked superbatch
             return runner.run_steps(self._program, feed_list=feed_list,
                                     fetch_list=fetch_list, steps=k,
-                                    return_numpy=return_numpy, **run_kwargs)
+                                    return_numpy=return_numpy,
+                                    as_futures=as_futures, **run_kwargs)
         chunks = [feed_list[i:i + k] for i in range(0, len(feed_list), k)]
         if _obs.enabled() and len(chunks) > 1 and len(chunks[-1]) != k:
             # a ragged tail chunk lowers a SECOND executable (steps=len
@@ -163,10 +168,17 @@ class CompiledProgram(_CompiledProgramBase):
             outs = [runner.run_steps(self._program, feed_list=c,
                                      fetch_list=fetch_list, steps=len(c),
                                      return_numpy=return_numpy,
+                                     as_futures=as_futures,
                                      **run_kwargs)
                     for c in chunks]
         if len(outs) == 1:
             return outs[0]
+        if as_futures:
+            # concatenate the chunk fetches ON DEVICE and re-wrap: the
+            # multi-chunk path stays sync-free end to end
+            from .core.async_runtime import FetchFuture
+            return [FetchFuture(_jnp_concat([o[i].device() for o in outs]))
+                    for i in range(len(outs[0]))]
         cat = np.concatenate if return_numpy else _jnp_concat
         return [cat([o[i] for o in outs]) for i in range(len(outs[0]))]
 

@@ -40,7 +40,12 @@ class EndStepEvent(object):
     """`telemetry` is a per-step snapshot of the observability counters/
     gauges ({name: value}, None when telemetry is disabled) — event
     handlers can watch executor.retraces / executor.stall_count /
-    prefetch.starvation_s climb live instead of post-mortem."""
+    prefetch.starvation_s climb live instead of post-mortem.
+
+    In async-metrics mode (``Trainer.train(async_metrics=M)``) `metrics`
+    holds lazy ``FetchFuture`` handles instead of numpy arrays: a handler
+    that ignores them costs ZERO host syncs; ``np.asarray(m)`` /
+    ``float(m)`` forces (and meters) the read on demand."""
 
     def __init__(self, epoch_id, step_id, metrics, telemetry=None):
         self.epoch = epoch_id
@@ -131,7 +136,8 @@ class Trainer(object):
         return 0
 
     def train(self, num_epochs, event_handler, reader=None,
-              feed_order=None, steps_per_launch=1, recovery=None):
+              feed_order=None, steps_per_launch=1, recovery=None,
+              async_metrics=None):
         """steps_per_launch=K fuses K train iterations into ONE device
         launch (Executor.run_steps — a jitted lax.scan), amortizing the
         per-launch dispatch cost.  Step events still fire per iteration
@@ -141,12 +147,29 @@ class Trainer(object):
 
         recovery: a train.RecoveryPolicy — a diverged launch (check_nan
         trip or loss spike) rolls back to the last checkpoint and the
-        offending superbatch is skipped instead of killing the run."""
+        offending superbatch is skipped instead of killing the run.
+
+        async_metrics=M (fused path only, docs/async.md) makes the
+        steady state fetch-free: launches return FetchFuture handles
+        (EndStepEvent.metrics are lazy per-step views), per-metric
+        running sums accumulate ON DEVICE, and one metered host sync
+        every M launches lands their means in ``self.last_metric_means``.
+        The loss-spike heuristic is skipped (it would read the loss per
+        launch); the deferred check_nan verdict covers divergence.
+        Checkpoints stay aligned with clean verdict polls: a save only
+        happens when ``exe.nan_clean()`` — so the restore point of a
+        deferred trip always predates the condemned window."""
         if steps_per_launch <= 1:
             return self._train_single(num_epochs, event_handler, reader,
                                       feed_order, recovery)
         feeder = self._feeder(feed_order, self.train_program)
         K = int(steps_per_launch)
+        use_async = async_metrics is not None and int(async_metrics) >= 1
+        sync_every = int(async_metrics) if use_async else 0
+        self.last_metric_means = None
+        self._metric_sums = None
+        self._metric_steps = 0
+        self._launches_since_sync = 0
         with scope_guard(self.scope):
             for epoch_id in range(self._resume_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
@@ -169,19 +192,49 @@ class Trainer(object):
                                       'steps': len(buf)}):
                             return self.exe.run_steps(
                                 self.train_program, feed_list=buf,
-                                fetch_list=fetch, steps=len(buf))
-                    stacked = launch() if recovery is None \
-                        else recovery.run(launch)
+                                fetch_list=fetch, steps=len(buf),
+                                as_futures=use_async)
+                    if recovery is None:
+                        stacked = launch()
+                    elif use_async:
+                        # the loss-spike heuristic would force a host read
+                        # per launch; the deferred check_nan verdict covers
+                        # divergence instead
+                        stacked = recovery.run(launch, loss_index=None)
+                    else:
+                        stacked = recovery.run(launch)
                     if stacked is None:
                         # diverged + rolled back: the superbatch is
-                        # skipped, its step ids stay consumed
+                        # skipped, its step ids stay consumed; on-device
+                        # sums accumulated since the last sync are part of
+                        # the condemned window — drop them with it
+                        if use_async:
+                            self._metric_sums = None
+                            self._metric_steps = 0
+                            self._launches_since_sync = 0
                         return step_id + len(buf)
+                    if use_async and stacked:
+                        self._accumulate_metrics(stacked, len(buf))
+                        if self._launches_since_sync >= sync_every:
+                            self._sync_metrics()
                     telem = _telemetry_snapshot()
                     for i in range(len(buf)):
-                        metrics = [np.asarray(m[i]) for m in stacked]
+                        if use_async:
+                            # lazy per-step views: a handler that ignores
+                            # them costs zero syncs
+                            metrics = [m[i] for m in stacked]
+                        else:
+                            metrics = [np.asarray(m[i]) for m in stacked]
                         if self.checkpointer:
-                            self.checkpointer.maybe_save(epoch_id,
-                                                         step_id + i)
+                            if self.exe.nan_clean():
+                                self.checkpointer.maybe_save(epoch_id,
+                                                             step_id + i)
+                            else:
+                                # verdicts still pending on device: record
+                                # progress but don't persist state the next
+                                # poll may condemn
+                                self.checkpointer.note_progress(epoch_id,
+                                                                step_id + i)
                         event_handler(EndStepEvent(epoch_id, step_id + i,
                                                    metrics, telemetry=telem))
                     return step_id + len(buf)
@@ -199,10 +252,55 @@ class Trainer(object):
                 if buf and not stopped:
                     step_id = flush(buf, step_id)
                 if stopped:
+                    if use_async:
+                        # force the deferred verdict before persisting:
+                        # never checkpoint state a pending poll condemns
+                        self.exe.poll_nan()
                     if self.checkpointer:
                         self.checkpointer.save(epoch_id, step_id)
                     return
+                if use_async:
+                    # epoch boundary: drain the verdict window (through
+                    # recovery so a late trip rolls back instead of
+                    # killing the run) and land the metric means
+                    def drain():
+                        self.exe.poll_nan()
+                        return []
+                    out = drain() if recovery is None \
+                        else recovery.run(drain, loss_index=None)
+                    if out is None:
+                        self._metric_sums = None
+                        self._metric_steps = 0
+                        self._launches_since_sync = 0
+                    self._sync_metrics()
                 event_handler(EndEpochEvent(epoch_id))
+
+    def _accumulate_metrics(self, stacked, steps):
+        """Fold one launch's stacked fetches into the on-device running
+        sums (async-metrics mode) — a pure device op, no host sync."""
+        import jax.numpy as jnp
+        sums = [jnp.sum(m.device(), axis=0) for m in stacked]
+        if self._metric_sums is None:
+            self._metric_sums = sums
+        else:
+            self._metric_sums = [a + s for a, s in
+                                 zip(self._metric_sums, sums)]
+        self._metric_steps += steps
+        self._launches_since_sync += 1
+
+    def _sync_metrics(self):
+        """ONE metered host sync for everything accumulated since the
+        last one: lands per-metric means in ``self.last_metric_means``."""
+        from ..core import async_runtime as _async
+        if self._metric_steps:
+            with _async.host_block('metric_sync',
+                                   steps=self._metric_steps):
+                sums = [np.asarray(s) for s in self._metric_sums]
+            self.last_metric_means = [s / float(self._metric_steps)
+                                      for s in sums]
+        self._metric_sums = None
+        self._metric_steps = 0
+        self._launches_since_sync = 0
 
     def _train_single(self, num_epochs, event_handler, reader, feed_order,
                       recovery=None):
@@ -234,7 +332,11 @@ class Trainer(object):
                     if metrics is None:
                         continue   # diverged step rolled back + skipped
                     if self.checkpointer:
-                        self.checkpointer.maybe_save(epoch_id, step_id)
+                        if self.exe.nan_clean():
+                            self.checkpointer.maybe_save(epoch_id, step_id)
+                        else:
+                            self.checkpointer.note_progress(epoch_id,
+                                                            step_id)
                     event_handler(EndStepEvent(
                         epoch_id, step_id, metrics,
                         telemetry=_telemetry_snapshot()))

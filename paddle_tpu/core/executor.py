@@ -14,6 +14,7 @@ import time
 import numpy as np
 
 from . import registry
+from . import async_runtime as _async
 from . import compile_cache as _cc
 from . import emit as _emit
 from . import passes as _passes
@@ -623,7 +624,8 @@ def _tail_split_enabled():
 class Executor(object):
     """Parity: reference executor.py Executor (run/close/feed/fetch API)."""
 
-    def __init__(self, place=None, mesh=None, check_nan=None):
+    def __init__(self, place=None, mesh=None, check_nan=None,
+                 nan_poll=None):
         self.place = place if place is not None else TPUPlace(0)
         self.mesh = mesh
         # nan/inf debug guard (SURVEY §2.8; parity: the reference's global
@@ -637,6 +639,15 @@ class Executor(object):
             check_nan = os.environ.get('FLAGS_check_nan_inf', '') in (
                 '1', 'true', 'True')
         self.check_nan = bool(check_nan)
+        # verdict poll cadence: the fused ok scalar accumulates on device
+        # (running AND) and is only READ every nan_poll steps — the read
+        # is the host sync that made check_nan cost 4x (PERF.md).  1 (the
+        # default without PT_ASYNC/PT_NAN_POLL) is the synchronous
+        # per-launch read, bit-for-bit.  Not part of the compile key: the
+        # executable computes the same verdict either way.
+        self.nan_poll = _async.default_nan_poll() if nan_poll is None \
+            else max(1, int(nan_poll))
+        self._nan = _async.DeferredNanVerdict(self.nan_poll)
         # L1 of the two-tier compilation cache (core/compile_cache.py):
         # fingerprinted executables, LRU-bounded by PT_EXEC_CACHE_MAX —
         # the seed's dict grew one executable per signature forever
@@ -659,6 +670,35 @@ class Executor(object):
         self._cache.clear()
         self._shard_targets.clear()
         self._steps_seen.clear()
+        self._nan.reset()
+
+    # ---------------------------------------------- deferred nan verdict
+    def nan_clean(self):
+        """True when no launch verdicts are pending an unread deferred
+        poll — i.e. checkpointing NOW cannot capture state a later poll
+        will condemn.  Always True with check_nan off or nan_poll=1
+        (every launch polls before returning)."""
+        return not self.check_nan or self._nan.pending_steps == 0
+
+    def poll_nan(self):
+        """Force the deferred verdict poll NOW (end of epoch/stream, or
+        before an aligned checkpoint).  Raises the standard check_nan
+        RuntimeError — with ``nan_window_steps`` attached — if any launch
+        since the last poll produced non-finite values.  No-op when
+        check_nan is off or nothing is pending."""
+        if not self.check_nan:
+            return
+        window = self._nan.poll()
+        if window:
+            e = RuntimeError(_async.DEFERRED_TRIP_MSG % window)
+            e.nan_window_steps = window
+            raise e
+
+    def reset_nan_window(self):
+        """Drop pending verdicts without reading them.  Recovery calls
+        this after a rollback: verdicts accumulated over the poisoned
+        stream say nothing about the restored state."""
+        self._nan.reset()
 
     # ------------------------------------------------------- rng/run state
     @staticmethod
@@ -743,19 +783,26 @@ class Executor(object):
 
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True, as_futures=False):
+        """``as_futures=True`` is the non-blocking fetch mode: the call
+        returns ``async_runtime.FetchFuture`` handles instead of arrays,
+        so the host never waits on the device — sync happens lazily at
+        ``.numpy()`` (metered in ``executor.host_blocked_s``).  The
+        launch itself is identical; ``return_numpy`` is ignored."""
         if program is None:
             program = default_main_program()
         if isinstance(program, _CompiledProgramBase):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            return program._run(self, feed, fetch_list, scope, return_numpy,
+                                as_futures=as_futures)
         scope = scope if scope is not None else global_scope()
         feed_vals = self._normalize_feed(program.global_block(), feed)
         return self._run_impl(program, feed_vals, fetch_list, scope,
-                              return_numpy, use_program_cache, steps=None)
+                              return_numpy, use_program_cache, steps=None,
+                              as_futures=as_futures)
 
     def run_steps(self, program=None, feed_list=None, fetch_list=None,
                   steps=None, scope=None, return_numpy=True,
-                  use_program_cache=True):
+                  use_program_cache=True, as_futures=False):
         """Run `steps` training iterations in ONE device launch.
 
         The K iterations lower to a single jitted lax.scan (see _lower):
@@ -768,13 +815,17 @@ class Executor(object):
         arrays are already stacked on a leading [K] axis (pass `steps`
         explicitly in that case — e.g. a superbatch from
         data_feeder.FeedPrefetcher).
-        Returns the fetches stacked per step: each entry is [K, ...].
+        Returns the fetches stacked per step: each entry is [K, ...]
+        (FetchFuture handles over the stacked device arrays when
+        ``as_futures=True`` — consecutive launches then chain on-device
+        with zero host round-trips between them).
         """
         if program is None:
             program = default_main_program()
         if isinstance(program, _CompiledProgramBase):
             return program._run_steps(self, feed_list, fetch_list, steps,
-                                      scope, return_numpy)
+                                      scope, return_numpy,
+                                      as_futures=as_futures)
         scope = scope if scope is not None else global_scope()
         block = program.global_block()
         if isinstance(feed_list, dict):
@@ -817,17 +868,20 @@ class Executor(object):
             # K' launches of the (reused-forever) single-step executable
             # consume the same RNG counters and are bitwise identical.
             return self._run_tail_split(program, feed_vals, fetch_list,
-                                        steps, scope, return_numpy)
+                                        steps, scope, return_numpy,
+                                        as_futures)
         self._steps_seen[seen_key] = max(kmax, steps)
         return self._run_impl(program, feed_vals, fetch_list, scope,
                               return_numpy, use_program_cache,
-                              steps=steps)
+                              steps=steps, as_futures=as_futures)
 
     def _run_tail_split(self, program, feed_vals, fetch_list, steps, scope,
-                        return_numpy):
+                        return_numpy, as_futures=False):
         """Run a ragged-tail superbatch as `steps` single-step launches.
         Output shape contract matches the fused path: fetches stacked on a
-        leading [steps] axis."""
+        leading [steps] axis.  The stack happens ON DEVICE — the per-step
+        launches pipeline asynchronously and the host only syncs once at
+        the end (return_numpy), or never (as_futures)."""
         if _obs.enabled():
             _obs.metrics.counter('executor.tail_splits').inc()
             _obs.instant('executor.tail_split', cat='compile',
@@ -836,12 +890,17 @@ class Executor(object):
                                {k: v[i] for k, v in feed_vals.items()},
                                fetch_list, scope, False, True, steps=None)
                 for i in range(steps)]
-        if return_numpy:
-            return [np.stack([np.asarray(o[j]) for o in outs])
-                    for j in range(len(outs[0]))]
         import jax.numpy as jnp
-        return [jnp.stack([o[j] for o in outs])
-                for j in range(len(outs[0]))]
+        stacked = [jnp.stack([o[j] for o in outs])
+                   for j in range(len(outs[0]))]
+        if as_futures:
+            return [_async.FetchFuture(s) for s in stacked]
+        if return_numpy:
+            with _async.host_block('tail_split_sync',
+                                   extra_counter='executor.fetch_sync_s',
+                                   steps=steps):
+                return [np.asarray(s) for s in stacked]
+        return stacked
 
     def _hot_key(self, program, feed_vals, fetch_names, steps):
         """In-process (L1) cache key.  Unlike the seed's key it includes
@@ -1115,7 +1174,8 @@ class Executor(object):
         return entry.fingerprint
 
     def _run_impl(self, program, feed_vals, fetch_list, scope,
-                  return_numpy, use_program_cache, steps):
+                  return_numpy, use_program_cache, steps,
+                  as_futures=False):
         feed_names = tuple(sorted(feed_vals.keys()))
         fetch_names = tuple(self._resolve_fetch(fetch_list))
 
@@ -1200,22 +1260,37 @@ class Executor(object):
         # holding deleted buffers right when the user wants to inspect it
         for n, v in updates.items():
             scope.vars[n] = v
-        if self.check_nan and not bool(result[2]):
-            # fused in-executable flag tripped: per-array pass to NAME
-            # the culprits (slow, but only runs on actual failure).  For a
-            # K-step launch the fetches are stacked [K, ...] and the
-            # updates are end-of-scan state — both still name the vars.
-            # The launch window must CLOSE before the raise: otherwise the
-            # next launch (after a divergence rollback) measures its gap
-            # from the launch before this one and reads the whole failed
-            # step + recovery as a phantom pipeline stall.
-            try:
-                self._assert_finite(itertools.chain(
-                    zip(fetch_names, fetches), updates.items()))
-            finally:
-                if obs_on:
-                    _obs.on_launch_end(self, time.perf_counter())
-        if return_numpy:
+        if self.check_nan:
+            # the fused verdict stays device-resident: push accumulates
+            # it into a running AND (async, no host read) and only a DUE
+            # window forces the one host sync.  nan_poll=1 makes every
+            # launch due — bit-for-bit the old per-launch bool(ok) read.
+            self._nan.push(result[2], steps or 1)
+            if self._nan.due():
+                window = self._nan.poll()
+                if window:
+                    # tripped: per-array pass to NAME the culprits (slow,
+                    # but only runs on actual failure).  For a K-step
+                    # launch the fetches are stacked [K, ...] and the
+                    # updates are end-of-scan state — both still name the
+                    # vars; a deferred window's culprit usually persists
+                    # into them (NaN propagates through params).  The
+                    # launch window must CLOSE before the raise: otherwise
+                    # the next launch (after a divergence rollback)
+                    # measures its gap from the launch before this one and
+                    # reads the whole failed step + recovery as a phantom
+                    # pipeline stall.
+                    try:
+                        self._raise_non_finite(fetch_names, fetches,
+                                               updates, window)
+                    finally:
+                        if obs_on:
+                            _obs.on_launch_end(self, time.perf_counter())
+        if as_futures:
+            # non-blocking fetch mode: hand back device handles; the sync
+            # (if any) happens at FetchFuture.numpy(), where it is metered
+            fetches = [_async.FetchFuture(f) for f in fetches]
+        elif return_numpy:
             # the host-sync point of the launch: converting fetches blocks
             # on the device — its duration is how long the async pipeline
             # made the host wait (near-zero in steady state)
@@ -1224,6 +1299,8 @@ class Executor(object):
             if obs_on:
                 t_f1 = time.perf_counter()
                 _obs.metrics.counter('executor.fetch_sync_s').inc(
+                    t_f1 - t_f0)
+                _obs.metrics.counter('executor.host_blocked_s').inc(
                     t_f1 - t_f0)
                 _obs.metrics.histogram('executor.fetch_sync_ms').observe(
                     (t_f1 - t_f0) * 1000.0)
@@ -1245,6 +1322,24 @@ class Executor(object):
             _obs.memory.on_launch()
             _obs.on_launch_end(self, t_w1)
         return fetches
+
+    def _raise_non_finite(self, fetch_names, fetches, updates, window):
+        """A (possibly deferred) verdict poll tripped: name the culprits
+        still visible in the latest launch's arrays, annotating the raise
+        with the window size; if the non-finite values no longer show
+        there (possible when the window spans launches), raise the
+        deferred-window message instead.  nan_poll=1 keeps today's exact
+        behavior: the naming pass over this launch's own arrays."""
+        try:
+            self._assert_finite(itertools.chain(
+                zip(fetch_names, fetches), updates.items()))
+        except RuntimeError as e:
+            e.nan_window_steps = window
+            raise
+        if window > 1:
+            e = RuntimeError(_async.DEFERRED_TRIP_MSG % window)
+            e.nan_window_steps = window
+            raise e
 
     @staticmethod
     def _assert_finite(named_arrays):
@@ -1279,9 +1374,10 @@ class _CompiledProgramBase(object):
     """Marker base so Executor.run can dispatch CompiledProgram wrappers
     (see compiler.py / parallel/parallel_executor.py)."""
 
-    def _run(self, exe, feed, fetch_list, scope, return_numpy):
+    def _run(self, exe, feed, fetch_list, scope, return_numpy,
+             as_futures=False):
         raise NotImplementedError
 
     def _run_steps(self, exe, feed_list, fetch_list, steps, scope,
-                   return_numpy):
+                   return_numpy, as_futures=False):
         raise NotImplementedError

@@ -264,6 +264,9 @@ class FeedPrefetcher(object):
         # telemetry: is the consumer currently blocked on an empty queue?
         # (pack work done while it ISN'T waiting overlapped its compute)
         self._consumer_waiting = False
+        # lifetime totals behind the prefetch.upload_overlap_ratio gauge
+        self._upload_s = 0.0
+        self._overlap_s = 0.0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._worker, name='FeedPrefetcher', daemon=True)
@@ -284,20 +287,26 @@ class FeedPrefetcher(object):
             import jax
             stacked = jax.device_put(stacked)
         if obs_on:
-            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            dt = t1 - t0
             _obs.metrics.counter('prefetch.superbatches').inc()
             _obs.metrics.counter('prefetch.upload_s').inc(dt)
+            self._upload_s += dt
             if overlapped:
                 # stacking+upload ran while the consumer was busy running
                 # the previous launch — the overlap the prefetcher exists
                 # to buy.  Upload time with the consumer parked on the
                 # queue is exposed transfer latency instead.
                 _obs.metrics.counter('prefetch.upload_overlap_s').inc(dt)
-            _obs.tracing.add_span('prefetch.pack', t0, time.perf_counter(),
+                self._overlap_s += dt
+            _obs.metrics.gauge('prefetch.upload_overlap_ratio').set(
+                self._overlap_s / self._upload_s if self._upload_s else 0.0)
+            _obs.tracing.add_span('prefetch.pack', t0, t1,
                                   cat='prefetch',
                                   args={'steps': len(buf),
                                         'overlapped': overlapped})
-        return stacked, len(buf)
+            return (stacked, len(buf)), (t0, t1)
+        return (stacked, len(buf)), None
 
     def _put(self, item):
         # bounded put that stays responsive to close(): never blocks
@@ -322,7 +331,7 @@ class FeedPrefetcher(object):
                 try:
                     next(self._src)
                 except StopIteration:
-                    self._put(('done', None))
+                    self._put(('done', None, None))
                     return
                 skipped += 1
             if skipped and _obs.enabled():
@@ -335,15 +344,17 @@ class FeedPrefetcher(object):
                 if len(buf) == self._steps:
                     if _faults.any_active():
                         _faults.maybe_sleep('prefetch_stall')
-                    if not self._put(('batch', self._pack(buf))):
+                    payload, span = self._pack(buf)
+                    if not self._put(('batch', payload, span)):
                         return
                     buf = []
             if buf:
-                if not self._put(('batch', self._pack(buf))):
+                payload, span = self._pack(buf)
+                if not self._put(('batch', payload, span)):
                     return
-            self._put(('done', None))
+            self._put(('done', None, None))
         except BaseException as e:  # noqa: BLE001 - relayed to consumer
-            self._put(('error', e))
+            self._put(('error', e, None))
 
     def __iter__(self):
         while True:
@@ -356,20 +367,43 @@ class FeedPrefetcher(object):
             if obs_on:
                 self._consumer_waiting = True
                 t0 = time.perf_counter()
-            kind, payload = self._q.get()
+            kind, payload, pack_span = self._q.get()
             if obs_on:
                 self._consumer_waiting = False
                 _obs.metrics.gauge('prefetch.queue_depth').set(
                     self._q.qsize())
                 if starved:
-                    # the training loop wanted the next superbatch and the
-                    # queue was empty: the reader is the bottleneck
-                    wait = time.perf_counter() - t0
-                    _obs.metrics.counter('prefetch.starvation_count').inc()
-                    _obs.metrics.counter('prefetch.starvation_s').inc(wait)
-                    _obs.tracing.add_span(
-                        'prefetch.starved', t0, time.perf_counter(),
-                        cat='prefetch')
+                    wait_t1 = time.perf_counter()
+                    wait = wait_t1 - t0
+                    # split the empty-queue wait: time spent with an
+                    # upload IN FLIGHT (the pack span overlapped the wait)
+                    # is transfer latency, not reader starvation — the two
+                    # need different fixes (bigger capacity / async upload
+                    # vs a faster reader)
+                    overlap = 0.0
+                    if pack_span is not None:
+                        overlap = max(0.0, min(wait_t1, pack_span[1]) -
+                                      max(t0, pack_span[0]))
+                        if overlap <= 1e-4:
+                            overlap = 0.0
+                    if overlap > 0.0:
+                        _obs.metrics.counter('prefetch.upload_waits').inc()
+                        _obs.metrics.counter(
+                            'prefetch.upload_wait_s').inc(overlap)
+                        _obs.tracing.add_span(
+                            'prefetch.upload_wait', t0, wait_t1,
+                            cat='prefetch')
+                    starve_s = wait - overlap
+                    if overlap == 0.0 or starve_s > 1e-4:
+                        # the training loop wanted the next superbatch and
+                        # the queue was empty: the reader is the bottleneck
+                        _obs.metrics.counter(
+                            'prefetch.starvation_count').inc()
+                        _obs.metrics.counter(
+                            'prefetch.starvation_s').inc(starve_s)
+                        _obs.tracing.add_span(
+                            'prefetch.starved', t0, wait_t1,
+                            cat='prefetch')
             if kind == 'done':
                 self._terminal = ('done',)
                 return

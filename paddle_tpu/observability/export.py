@@ -111,6 +111,9 @@ SCHEMA = {
         ('fetch_sync_s', ('sec', 'executor.fetch_sync_s')),
         ('kernel_fallbacks', ('int', 'kernel.fallbacks')),
         ('emitter_fallbacks', ('int', 'emitter.fallbacks')),
+        ('host_blocked_s', ('sec', 'executor.host_blocked_s')),
+        ('nan_poll_lag_steps', ('int', 'nan_poll.lag_steps')),
+        ('prefetch_upload_overlap_s', ('sec', 'prefetch.upload_overlap_s')),
     ),
     'serving': (
         ('admitted', ('int', 'serving.admitted')),
@@ -143,7 +146,9 @@ SCHEMA = {
             'ckpt.desync_dropped', 'health.beats', 'health.trips',
             'health.lost_hosts', 'health.desyncs', 'retry.attempts',
             'executor.retraces', 'executor.stall_count',
-            'prefetch.starvation_count', 'kernel.fallbacks'))),
+            'prefetch.starvation_count', 'kernel.fallbacks',
+            'nan_poll.polls', 'nan_poll.trips',
+            'executor.host_blocked_s'))),
     ),
 }
 

@@ -57,11 +57,26 @@ class ParallelExecutor(object):
     def set_rng_state(self, state):
         return self._exe.set_rng_state(state)
 
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+    # deferred-nan duck-type (core/executor.py): recovery resets the
+    # verdict window through the Checkpointer's executor handle, and
+    # checkpoint alignment asks nan_clean() — both must reach the inner
+    # Executor that actually accumulates the verdicts
+    def nan_clean(self):
+        return self._exe.nan_clean()
+
+    def poll_nan(self):
+        return self._exe.poll_nan()
+
+    def reset_nan_window(self):
+        return self._exe.reset_nan_window()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True,
+            as_futures=False):
         feed = feed if feed is not None else feed_dict
         return self._exe.run(self._main_program, feed=feed,
                              fetch_list=list(fetch_list),
-                             scope=self._scope, return_numpy=return_numpy)
+                             scope=self._scope, return_numpy=return_numpy,
+                             as_futures=as_futures)
 
     def prepare(self, program=None, feed=None, fetch_list=None, scope=None,
                 steps=None):

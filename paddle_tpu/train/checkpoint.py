@@ -332,14 +332,24 @@ class Checkpointer(object):
                         'dtype': str(arrays[n].dtype),
                         'start': lo, 'stop': hi}
         if obs_on:
+            t1 = time.perf_counter()
             # host-memory accounting: each queued snapshot pins this many
             # bytes of host RAM until its background write drains
             nbytes = sum(a.nbytes for a in arrays.values())
             _obs.metrics.gauge('ckpt.snapshot_host_bytes').set(nbytes)
             _obs.metrics.counter('ckpt.snapshot_bytes_total').inc(nbytes)
-            _obs.tracing.add_span('ckpt.snapshot', t0, time.perf_counter(),
+            _obs.tracing.add_span('ckpt.snapshot', t0, t1,
                                   cat='ckpt', args={'arrays': len(arrays),
                                                     'bytes': nbytes})
+            # the copies above are forced device->host reads (scope read):
+            # they block on every in-flight launch that owns those
+            # buffers — the one part of "async" checkpointing that can
+            # still serialize the device, so it counts as host-blocked
+            # time (core/async_runtime.host_block taxonomy)
+            _obs.metrics.counter('executor.host_blocked_s').inc(t1 - t0)
+            _obs.tracing.add_span('host_block', t0, t1, cat='launch',
+                                  args={'reason': 'ckpt_snapshot',
+                                        'arrays': len(arrays)})
         return arrays, specs
 
     def save(self, epoch_id, step_id, extra_meta=None, blocking=None):

@@ -119,6 +119,16 @@ class RecoveryPolicy(object):
                 raise
             self._consecutive += 1
             _obs.metrics.counter('recovery.divergences').inc()
+            window = int(getattr(e, 'nan_window_steps', 0) or 0)
+            if window > 1:
+                # a DEFERRED verdict poll tripped (executor nan_poll > 1):
+                # the divergence is localized to the last `window` steps,
+                # not one step — the rollback below restores the last
+                # checkpoint saved before that window (nan_clean-aligned
+                # saves guarantee it predates the poison)
+                _obs.metrics.counter('recovery.deferred_trips').inc()
+                _flight.record('recovery.deferred_trip',
+                               window_steps=window)
             if self._consecutive > self.max_retries:
                 _obs.metrics.counter('recovery.giveups').inc()
                 _flight.record('recovery.giveup', error=repr(e)[:300],
@@ -154,10 +164,16 @@ class RecoveryPolicy(object):
                     scope.set(self.lr_var,
                               (lr * self.lr_scale).astype(lr.dtype))
                     _obs.metrics.counter('recovery.lr_scaled').inc()
+        # drop any verdicts still accumulating on device: they were
+        # computed over the poisoned (pre-restore) stream and would trip
+        # a later poll against the clean restored state
+        exe = getattr(self.checkpointer, 'executor', None)
+        if exe is not None and hasattr(exe, 'reset_nan_window'):
+            exe.reset_nan_window()
         # the restore + replay window is an intentional gap, not a stall:
         # forget the launch-gap baseline so the first replayed launch is
         # not measured against the pre-rollback timeline
-        _obs.stall.clear_window(getattr(self.checkpointer, 'executor', None))
+        _obs.stall.clear_window(exe)
         # divergences survive rollback history: a spike right after a
         # rollback should still count toward give-up, but the loss
         # history predates the poisoned step and stays valid

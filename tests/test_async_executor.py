@@ -1,0 +1,341 @@
+"""Fully-async executor: FetchFuture fetches, deferred nan verdict,
+chained launches (docs/async.md).
+
+The contract under test: async mode (as_futures=True + nan_poll>1) is
+BITWISE identical to the synchronous path — same losses, same param and
+optimizer state, same RNG stream — while never forcing a host sync in
+steady state; a deferred verdict trip localizes the divergence to the
+last poll window and rolls back cleanly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.core.async_runtime import FetchFuture
+from paddle_tpu.testing import faults
+
+
+def _train_model(seed=7, dropout=0.5, amp=False):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+            h = fluid.layers.fc(x, 16, act='relu')
+            if dropout:
+                h = fluid.layers.dropout(h, dropout_prob=dropout)
+            logits = fluid.layers.fc(h, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, lbl))
+            fluid.optimizer.Adam(0.01).minimize(loss)
+    if amp:
+        main.set_amp(True)
+    return main, startup, loss
+
+
+def _feeds(n, batch=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{'x': rng.randn(batch, 8).astype('float32'),
+             'lbl': rng.randint(0, 4, (batch, 1)).astype('int64')}
+            for _ in range(n)]
+
+
+def _scope_bytes(scope):
+    return {n: np.asarray(scope.vars[n]).tobytes() for n in scope.vars}
+
+
+# ------------------------------------------------------ bitwise parity
+
+def test_run_parity_async_vs_sync():
+    """Single-step async (futures + deferred poll) vs sync: losses and
+    final param/Adam state bitwise equal — same RNG stream, same math."""
+    N = 6
+    main, startup, loss = _train_model()
+    feeds = _feeds(N)
+
+    exe_s = fluid.Executor(check_nan=True, nan_poll=1)
+    scope_s = fluid.Scope()
+    with fluid.scope_guard(scope_s):
+        exe_s.run(startup)
+        sync_losses = [np.asarray(exe_s.run(main, feed=f,
+                                            fetch_list=[loss])[0])
+                       for f in feeds]
+
+    exe_a = fluid.Executor(check_nan=True, nan_poll=4)
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe_a.run(startup)
+        futs = [exe_a.run(main, feed=f, fetch_list=[loss],
+                          as_futures=True)[0] for f in feeds]
+        exe_a.poll_nan()   # drain: all verdicts were clean
+        async_losses = [np.asarray(f) for f in futs]
+
+    for a, b in zip(sync_losses, async_losses):
+        assert a.tobytes() == b.tobytes()
+    sb, ab = _scope_bytes(scope_s), _scope_bytes(scope_a)
+    assert set(sb) == set(ab)
+    for n in sb:
+        assert sb[n] == ab[n], 'state mismatch in %s' % n
+
+
+@pytest.mark.parametrize('nan_poll', [1, 4])
+def test_run_steps_parity_async_vs_sync_amp(nan_poll):
+    """Fused K-step launches under AMP + dropout: the async fetch mode
+    must not perturb the RNG stream or the bf16 master-weight updates."""
+    K, launches = 4, 2
+    main, startup, loss = _train_model(amp=True)
+    feeds = _feeds(K * launches)
+    chunks = [feeds[i * K:(i + 1) * K] for i in range(launches)]
+
+    exe_s = fluid.Executor(check_nan=True, nan_poll=1)
+    scope_s = fluid.Scope()
+    with fluid.scope_guard(scope_s):
+        exe_s.run(startup)
+        sync_losses = [np.asarray(exe_s.run_steps(
+            main, feed_list=c, fetch_list=[loss], steps=K)[0])
+            for c in chunks]
+
+    exe_a = fluid.Executor(check_nan=True, nan_poll=nan_poll)
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe_a.run(startup)
+        futs = [exe_a.run_steps(main, feed_list=c, fetch_list=[loss],
+                                steps=K, as_futures=True)[0]
+                for c in chunks]
+        exe_a.poll_nan()
+        async_losses = [np.asarray(f) for f in futs]
+
+    for a, b in zip(sync_losses, async_losses):
+        assert a.tobytes() == b.tobytes()
+    sb, ab = _scope_bytes(scope_s), _scope_bytes(scope_a)
+    for n in sb:
+        assert sb[n] == ab[n], 'state mismatch in %s' % n
+
+
+def test_parallel_executor_parity_async():
+    """ParallelExecutor over the 8-device mesh: as_futures returns lazy
+    handles whose values match the blocking path bitwise."""
+    losses = {}
+    for tag, as_futures in [('sync', False), ('async', True)]:
+        main, startup, loss = _train_model(seed=3, dropout=0.0)
+        exe = fluid.Executor()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                        main_program=main, scope=scope)
+            vals = []
+            for f in _feeds(3, batch=16):
+                out = pe.run([loss.name], feed=f, as_futures=as_futures)
+                vals.append(np.asarray(out[0]))
+        losses[tag] = vals
+    for a, b in zip(losses['sync'], losses['async']):
+        assert a.tobytes() == b.tobytes()
+    # nan-verdict duck-type reaches the inner executor
+    assert pe.nan_clean() is True
+    pe.poll_nan()          # nothing pending: no-op, no raise
+    pe.reset_nan_window()
+
+
+# ------------------------------------------------- deferred nan verdict
+
+def test_deferred_trip_localizes_window():
+    """nan_poll=4: a NaN produced on the 2nd launch must NOT raise until
+    the 4th (the poll), and the raise names the 4-step window."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[4], dtype='float32')
+            y = fluid.layers.fc(x, 3)
+            loss = fluid.layers.reduce_mean(y)
+    exe = fluid.Executor(check_nan=True, nan_poll=4)
+    scope = fluid.Scope()
+    clean = {'x': np.ones((2, 4), np.float32)}
+    poison = {'x': np.full((2, 4), np.nan, np.float32)}
+    c0 = obs.counters()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.poll_nan()   # drain the startup verdict: window starts at 0
+        exe.run(main, feed=clean, fetch_list=[loss])    # 1: fine
+        exe.run(main, feed=poison, fetch_list=[loss])   # 2: NaN, deferred
+        assert not exe.nan_clean()
+        exe.run(main, feed=clean, fetch_list=[loss])    # 3: still deferred
+        with pytest.raises(RuntimeError, match='check_nan') as ei:
+            exe.run(main, feed=clean, fetch_list=[loss])  # 4: poll trips
+        assert ei.value.nan_window_steps == 4
+        # window reset by the poll: the next runs are clean again
+        assert exe.nan_clean()
+        for _ in range(4):
+            exe.run(main, feed=clean, fetch_list=[loss])
+        assert exe.nan_clean()   # 8th run polled clean
+    c1 = obs.counters()
+    assert c1.get('nan_poll.trips', 0) - c0.get('nan_poll.trips', 0) == 1
+    assert c1.get('nan_poll.polls', 0) - c0.get('nan_poll.polls', 0) >= 2
+
+
+def test_nan_clean_and_poll_semantics():
+    main, startup, loss = _train_model(dropout=0.0)
+    exe = fluid.Executor(check_nan=True, nan_poll=3)
+    scope = fluid.Scope()
+    f = _feeds(1)[0]
+    with fluid.scope_guard(scope):
+        exe.run(startup)          # push 1
+        assert not exe.nan_clean()
+        exe.run(main, feed=f, fetch_list=[loss])   # push 2
+        assert not exe.nan_clean()
+        exe.poll_nan()            # clean forced poll
+        assert exe.nan_clean()
+        exe.run(main, feed=f, fetch_list=[loss])
+        exe.reset_nan_window()    # rollback path: drop without reading
+        assert exe.nan_clean()
+    # check_nan off: always clean, poll is a no-op
+    exe2 = fluid.Executor(check_nan=False, nan_poll=4)
+    assert exe2.nan_clean()
+    exe2.poll_nan()
+
+
+def test_deferred_rollback_localizes_to_window(tmp_path):
+    """The fault_soak async scenario in-process: nan_step mid-window,
+    trip at the NEXT poll, rollback to the last clean-verdict checkpoint,
+    run completes with every landed loss finite."""
+    from paddle_tpu.train import (CheckpointConfig, Checkpointer,
+                                  RecoveryPolicy)
+    main, startup, loss = _train_model(seed=17)
+    exe = fluid.Executor(check_nan=True, nan_poll=4)
+    scope = fluid.Scope()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1),
+                      exe, main, scope=scope)
+    policy = RecoveryPolicy(ck, max_retries=4)
+    feeds = _feeds(16, seed=5)
+    K = 2
+    c0 = obs.counters()
+    losses, pending, skipped = [], [], 0
+    try:
+        faults.configure('nan_step:at=5')
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ck.save(0, -1)
+            ck.wait()
+            for i in range(0, 16, K):
+                out = policy.run(lambda: exe.run_steps(
+                    main, feed_list=feeds[i:i + K], steps=K,
+                    fetch_list=[loss], as_futures=True))
+                if out is None:
+                    skipped += K + sum(n for _, n in pending)
+                    pending = []
+                    continue
+                pending.append((out[0], K))
+                if exe.nan_clean():
+                    for fut, _ in pending:
+                        losses.extend(np.asarray(fut).ravel())
+                    pending = []
+                    ck.maybe_save(0, i + K - 1)
+            exe.poll_nan()
+            for fut, _ in pending:
+                losses.extend(np.asarray(fut).ravel())
+            ck.wait()
+    finally:
+        faults.reset()
+    c1 = obs.counters()
+
+    def delta(k):
+        return (c1.get(k) or 0) - (c0.get(k) or 0)
+
+    assert delta('recovery.rollbacks') == 1
+    assert delta('recovery.deferred_trips') == 1
+    assert delta('nan_poll.trips') == 1
+    assert delta('faults.injected.nan_step') == 1
+    # poisoned launch + the launch that tripped the poll were condemned
+    assert skipped == 4
+    assert len(losses) == 12
+    assert np.all(np.isfinite(losses))
+
+
+# -------------------------------------------------- zero-sync steady state
+
+def test_chained_launches_never_block_host():
+    """Back-to-back as_futures launches: zero host-blocked seconds, zero
+    pipeline stalls, until the caller actually reads a future."""
+    K = 3
+    main, startup, loss = _train_model(dropout=0.0)
+    exe = fluid.Executor(check_nan=False)
+    scope = fluid.Scope()
+    feeds = _feeds(K * 3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # warmup: compile the K-step executable outside the window
+        exe.run_steps(main, feed_list=feeds[:K], steps=K,
+                      fetch_list=[loss], as_futures=True)
+        c0 = obs.counters()
+        f1, = exe.run_steps(main, feed_list=feeds[K:2 * K], steps=K,
+                            fetch_list=[loss], as_futures=True)
+        f2, = exe.run_steps(main, feed_list=feeds[2 * K:], steps=K,
+                            fetch_list=[loss], as_futures=True)
+        c1 = obs.counters()
+        # the launches chained on the donated device carry: the host
+        # never waited on the device between them
+        assert (c1.get('executor.host_blocked_s') or 0) == \
+            (c0.get('executor.host_blocked_s') or 0)
+        assert (c1.get('executor.stall_count') or 0) == \
+            (c0.get('executor.stall_count') or 0)
+        # first host read: blocks, and the block is metered
+        v1, v2 = np.asarray(f1), np.asarray(f2)
+        c2 = obs.counters()
+        assert (c2.get('executor.host_blocked_s') or 0) > \
+            (c1.get('executor.host_blocked_s') or 0)
+    assert v1.shape[0] == K and np.all(np.isfinite(v2))
+
+
+def test_fetch_future_api():
+    import jax.numpy as jnp
+    c0 = obs.counters().get('executor.host_blocked_s') or 0
+    fut = FetchFuture(jnp.arange(6.0).reshape(2, 3))
+    assert fut.shape == (2, 3) and len(fut) == 2
+    assert 'pending' in repr(fut)
+    row = fut[0]                      # lazy device-side slice
+    assert isinstance(row, FetchFuture) and row.shape == (3,)
+    a = fut.numpy()
+    assert fut.numpy() is a           # cached: one sync total
+    assert 'synced' in repr(fut)
+    np.testing.assert_array_equal(np.asarray(fut), a)
+    assert float(row[0]) == 0.0
+    assert fut.block() is fut and fut.ready()
+    assert fut.device() is not None
+    c1 = obs.counters().get('executor.host_blocked_s') or 0
+    assert c1 > c0                    # the reads were metered
+
+
+# ------------------------------------------------------------ prefetcher
+
+def test_prefetcher_upload_wait_not_starvation():
+    """A consumer waiting on a pack/upload IN FLIGHT is transfer latency
+    (prefetch.upload_wait_s), not reader starvation."""
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    import time as _time
+
+    class SlowPack(FeedPrefetcher):
+        # simulate a 0.15s device upload: widen the pack span over the
+        # sleep so the consumer's wait overlaps an upload in flight
+        def _pack(self, buf):
+            t0 = _time.perf_counter()
+            _time.sleep(0.15)
+            payload, span = FeedPrefetcher._pack(self, buf)
+            return payload, ((t0, span[1]) if span else None)
+
+    feeds = [{'x': np.full((2, 2), i, np.float32)} for i in range(4)]
+    c0 = obs.counters()
+    pf = SlowPack(iter(feeds), steps=2, to_device=False)
+    got = [k for _, k in pf]
+    pf.close()
+    c1 = obs.counters()
+    assert got == [2, 2]
+    assert (c1.get('prefetch.upload_waits') or 0) >= \
+        (c0.get('prefetch.upload_waits') or 0) + 1
+    assert (c1.get('prefetch.upload_wait_s') or 0) - \
+        (c0.get('prefetch.upload_wait_s') or 0) > 0.1
+    # the wait was attributed to the in-flight upload, not the reader
+    assert (c1.get('prefetch.starvation_s') or 0) - \
+        (c0.get('prefetch.starvation_s') or 0) < 0.05
+    assert obs.counters().get('prefetch.upload_overlap_ratio') is not None

@@ -221,6 +221,26 @@ if [ "$resume_rc" -ne 0 ]; then
 fi
 rm -rf "$soak_dir"
 
+echo "== ci_smoke: async executor soak (deferred nan poll, PT_ASYNC=1) =="
+# fully-async gate (docs/async.md): the SAME fault soak but with the
+# executor in async mode — launches return FetchFuture handles, the fused
+# all-finite verdict stays device-resident between polls (PT_NAN_POLL=4),
+# and a mid-window nan_step fault must trip a DEFERRED poll, roll back to
+# the last clean-verdict checkpoint, and finish with finite losses.
+# --expect-async requires nan_poll.polls>=1 AND nan_poll.trips>=1;
+# --assert-recovery keeps steady-state stalls pinned at ZERO — the whole
+# point of the async executor.
+async_dir=$(mktemp -d /tmp/pt_async.XXXXXX)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 PT_ASYNC=1 \
+    PT_NAN_POLL=4 PT_FAULT="nan_step:at=5" \
+    python tools/fault_soak.py --steps 16 --ckpt "$async_dir/ckpt" \
+    --assert-recovery --expect-async
+async_rc=$?
+if [ "$async_rc" -ne 0 ]; then
+    echo "ci_smoke: async executor soak FAILED (rc=$async_rc)"
+fi
+rm -rf "$async_dir"
+
 echo "== ci_smoke: pod soak (sharded ckpt, kill-and-resume, reshard) =="
 # pod-resilience gate (docs/robustness.md): two sharded-checkpoint
 # trainers over one directory; wave 1 SIGKILLs a worker mid-run (the
@@ -344,7 +364,8 @@ rec2 = json.loads(sys.argv[2].strip().splitlines()[-1])
 expected = [
     'metric', 'value', 'unit', 'vs_baseline', 'mfu', 'model_tflops_per_s',
     'params_m', 'matmul_params_m', 'backend', 'batch', 'seq', 'amp',
-    'flash', 'steps_per_launch', 'single_step_tokens_per_sec', 'telemetry',
+    'flash', 'steps_per_launch', 'single_step_tokens_per_sec',
+    'sync_mode_tokens_per_sec', 'check_nan_overhead_x', 'telemetry',
 ]
 missing = [k for k in expected if k not in rec]
 if missing:
@@ -365,7 +386,9 @@ tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'program_op_count_raw', 'program_op_count_opt',
                 'opt_pass_ms', 'opt_ops_fused', 'stall_count',
                 'prefetch_starvation_s', 'fetch_sync_s',
-                'kernel_fallbacks', 'emitter_fallbacks']
+                'kernel_fallbacks', 'emitter_fallbacks',
+                'host_blocked_s', 'nan_poll_lag_steps',
+                'prefetch_upload_overlap_s']
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
     sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
@@ -461,5 +484,6 @@ fi
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$emit_zoo_rc" -eq 0 ] && \
     [ "$soak_rc" -eq 0 ] && \
-    [ "$resume_rc" -eq 0 ] && [ "$pod_rc" -eq 0 ] && \
+    [ "$resume_rc" -eq 0 ] && [ "$async_rc" -eq 0 ] && \
+    [ "$pod_rc" -eq 0 ] && \
     [ "$serve_rc" -eq 0 ] && [ "$decode_rc" -eq 0 ]

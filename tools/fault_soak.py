@@ -15,7 +15,11 @@ site table).  Used by tools/ci_smoke.sh:
            (--assert-recovery);
   phase 2: PT_FAULT=sigterm:at=K kills the process mid-run (the signal
            handler flushes a final checkpoint); a second invocation with
-           --expect-resume must restore it and finish the run.
+           --expect-resume must restore it and finish the run;
+  phase 3: PT_ASYNC=1 PT_NAN_POLL=N re-runs phase 1 fully async —
+           FetchFuture launches, deferred nan verdict — and
+           --expect-async requires >=1 verdict poll AND >=1 deferred
+           trip with zero steady-state stalls.
 
 Prints one JSON line: {"steps_done": ..., "start": ..., "counters": ...}.
 """
@@ -38,6 +42,9 @@ def main():
                          'post-recovery retraces, zero pipeline stalls')
     ap.add_argument('--expect-resume', action='store_true',
                     help='require a valid checkpoint to resume from')
+    ap.add_argument('--expect-async', action='store_true',
+                    help='require the deferred-nan async mode (nan_poll>1) '
+                         'with >=1 verdict poll and >=1 deferred trip')
     args = ap.parse_args()
 
     import numpy as np
@@ -83,12 +90,24 @@ def main():
 
     policy = RecoveryPolicy(ck, max_retries=4)
     K = args.launch_k
+    # PT_ASYNC=1 / PT_NAN_POLL>1 puts the soak in the fully-async mode:
+    # launches return FetchFuture handles, the fused all-finite verdict
+    # accumulates on device, and losses only land on the host after a
+    # CLEAN poll — a deferred trip condemns (drops) the whole window
+    use_async = exe.nan_poll > 1
     pf = FeedPrefetcher((feed_at(i) for i in range(start, args.steps)),
                         steps=K, to_device=False)
     losses = []
     skipped = 0
+    pending = []          # [(loss_future, k)] awaiting a clean verdict
     retrace_mark = None   # executor.retraces at the first rollback
     stall_mark = None     # executor.stall_count once steady state begins
+
+    def flush_pending():
+        for f, _ in pending:
+            losses.extend(float(v) for v in np.asarray(f).ravel())
+        del pending[:]
+
     with fluid.scope_guard(scope):
         if meta is None:
             exe.run(startup)
@@ -99,7 +118,8 @@ def main():
         step = start
         for stacked, k in pf:
             out = policy.run(lambda: exe.run_steps(
-                main_prog, feed_list=stacked, steps=k, fetch_list=[loss]))
+                main_prog, feed_list=stacked, steps=k, fetch_list=[loss],
+                as_futures=use_async))
             if stall_mark is None:
                 # steady state starts AFTER the first fused launch: the
                 # cold-start gap (startup program, initial blocking save,
@@ -108,7 +128,11 @@ def main():
                 stall_mark = int(
                     obs.counters().get('executor.stall_count') or 0)
             if out is None:
-                skipped += k
+                # rolled back: steps pending a verdict were computed on
+                # the now-condemned window — drop them with the rollback
+                dropped = sum(n for _, n in pending)
+                del pending[:]
+                skipped += k + dropped
                 step += k
                 # everything after a rollback must reuse the cached
                 # executables: restored numpy params have identical
@@ -117,9 +141,30 @@ def main():
                     retrace_mark = int(
                         obs.counters().get('executor.retraces') or 0)
                 continue
-            losses.extend(float(v) for v in np.asarray(out[0]).ravel())
-            ck.maybe_save(0, step + k - 1)
+            if use_async:
+                pending.append((out[0], k))
+                if exe.nan_clean():
+                    # verdict window just polled clean: everything
+                    # buffered is good — land it and checkpoint
+                    flush_pending()
+                    ck.maybe_save(0, step + k - 1)
+            else:
+                losses.extend(float(v) for v in np.asarray(out[0]).ravel())
+                ck.maybe_save(0, step + k - 1)
             step += k
+        if use_async and pending:
+            # end of stream with verdicts still on device: force the poll
+            # (through recovery, so a late trip rolls back cleanly)
+            def drain():
+                exe.poll_nan()
+                return []
+            tail = policy.run(drain)
+            if tail is None:
+                skipped += sum(n for _, n in pending)
+                del pending[:]
+            else:
+                flush_pending()
+                ck.maybe_save(0, step - 1)
         ck.wait()
     c = obs.counters()
     retraces_after_recovery = 0 if retrace_mark is None else \
@@ -158,6 +203,17 @@ def main():
             sys.exit('fault_soak: %d steady-state pipeline stall(s) — '
                      'async checkpointing (or recovery) is blocking the '
                      'step loop' % rec['steady_state_stalls'])
+    if args.expect_async:
+        cc = rec['counters']
+        if exe.nan_poll <= 1:
+            sys.exit('fault_soak: --expect-async but nan_poll=%d — set '
+                     'PT_ASYNC=1 or PT_NAN_POLL>1' % exe.nan_poll)
+        if cc['nan_poll.polls'] < 1:
+            sys.exit('fault_soak: --expect-async but the deferred verdict '
+                     'was never polled')
+        if cc['nan_poll.trips'] < 1:
+            sys.exit('fault_soak: --expect-async but no deferred trip — '
+                     'the nan_step fault did not exercise the window')
     return 0
 
 
