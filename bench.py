@@ -34,7 +34,11 @@ BASELINE_TOKENS_PER_SEC = 5100.0
 BASELINE_RESNET_IMAGES_PER_SEC = 360.0
 # canonical ResNet-50 224x224 forward cost; training ~= 3x forward
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
-PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_TIMEOUT', '300'))
+# BENCH_PROBE_S is the documented knob (default 60s — a healthy PJRT init
+# is seconds, and BENCH_r05 showed a hung one never recovers, so 300s only
+# delayed the CPU fallback); BENCH_PROBE_TIMEOUT kept for back-compat.
+PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_S')
+                      or os.environ.get('BENCH_PROBE_TIMEOUT') or '60')
 
 # peak bf16 FLOP/s by TPU generation (public spec sheets)
 _PEAK_BF16 = {
@@ -221,9 +225,47 @@ def bench_resnet50(on_tpu, device_kind):
             'resnet50_mfu': mfu, 'resnet50_batch': B}
 
 
+def bench_fused_adam(fluid):
+    """Micro-bench the fused-Adam update path: a tiny 2-layer model whose
+    optimizer sub-program fuses into one fused_elementwise group (ONE
+    generated Pallas kernel when PT_KERNELGEN=1).  Returns avg ms per
+    train step — the ledger row for the kernelgen tier's headline op."""
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('fa_x', shape=[64], dtype='float32')
+            h = fluid.layers.fc(x, size=64, act='relu')
+            y = fluid.layers.fc(h, size=64)
+            loss = fluid.layers.reduce_mean(y * y)
+            opt = fluid.optimizer.Adam(learning_rate=1e-3)
+            opt.minimize(loss)
+    exe, scope = fluid.Executor(), fluid.Scope()
+    feed = {'fa_x': np.random.RandomState(0)
+            .rand(32, 64).astype('float32')}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):  # compile + warmup
+            exe.run(main_prog, feed=feed, fetch_list=[loss])
+        steps = 20
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            exe.run(main_prog, feed=feed, fetch_list=[loss],
+                    return_numpy=False)
+        lv, = exe.run(main_prog, feed=feed, fetch_list=[loss])
+        np.asarray(lv)  # block
+        dt = time.perf_counter() - t0
+    return round(dt / (steps + 1) * 1000.0, 3)
+
+
 def main():
+    # the codegen tier is the bench default: the headline number should
+    # measure generated kernels, and kernelgen_ops/kernelgen_fallbacks in
+    # the telemetry make a silent degrade visible
+    os.environ.setdefault('PT_KERNELGEN', '1')
     stage('probe')
+    t_probe = time.perf_counter()
     platform, kind_or_reason = probe_backend()
+    probe_s = round(time.perf_counter() - t_probe, 1)
     fallback_reason = None
     if platform is None:
         fallback_reason = kind_or_reason
@@ -401,17 +443,32 @@ def main():
     # cache load seconds, ci_smoke asserts the second run collapses) and
     # kernel_fallbacks (a pallas kernel degraded to its composed path)
     # are documented in the schema + docs/observability.md.
+    stage('fused_adam')
+    try:
+        fused_adam_ms = bench_fused_adam(fluid)
+        print('BENCH: fused-adam step ok: %.3f ms' % fused_adam_ms,
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - ledger row is best-effort
+        print('BENCH: fused-adam bench failed: %s' % e, file=sys.stderr)
+        fused_adam_ms = None
+
     telemetry = obs.telemetry_snapshot(
         'bench', baseline=snap0, snapshot=snap1,
         extra={'platform': dev0.platform,
                'device_kind': str(dev0.device_kind),
                'program_op_count_raw': raw_ops,
-               'program_op_count_opt': opt_ops})
+               'program_op_count_opt': opt_ops,
+               'fused_adam_ms': fused_adam_ms})
     if telemetry['kernel_fallbacks']:
         print('BENCH: WARNING — %d kernel fallback(s): a pallas kernel '
               'degraded to its composed path (run PT_STRICT_KERNELS=1 '
               'to get the raw error)' % telemetry['kernel_fallbacks'],
               file=sys.stderr)
+    if telemetry['kernelgen_fallbacks']:
+        print('BENCH: WARNING — %d kernelgen fallback(s): a fused group '
+              'degraded from its generated Pallas kernel to replay (run '
+              'PT_STRICT_KERNELS=1 to get the raw error)'
+              % telemetry['kernelgen_fallbacks'], file=sys.stderr)
     if telemetry['emitter_fallbacks']:
         print('BENCH: WARNING — %d emitter fallback(s): the direct '
               'Program→jaxpr emitter degraded to traced lowering (run '
@@ -471,6 +528,9 @@ def main():
         'telemetry': telemetry,
     }
     rec.update(resnet_rec)
+    # probe accounting: how long the backend probe took (budget
+    # PROBE_TIMEOUT_S) and, on failure, the hang/crash reason
+    rec['probe_s'] = probe_s
     if fallback_reason:
         rec['fallback'] = fallback_reason
     if ar_bw is not None:

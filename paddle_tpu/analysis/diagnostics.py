@@ -22,6 +22,7 @@ Code table (docs/analysis.md has the full semantics):
   D013 warning  numerical hazard: softmax built without max-subtraction
   D014 warning  degenerate learning-rate decay constant
   D015 info     op not emit-capable (direct emitter would fall back)
+  D016 info     fused sub-op not kernelgen-capable (replay fallback)
   D099 info     lint pass crashed (analyzer bug, never fatal)
 """
 
@@ -46,6 +47,7 @@ CODES = {
     'D013': 'softmax without max-subtraction',
     'D014': 'degenerate lr decay',
     'D015': 'op not emit-capable',
+    'D016': 'fused sub-op not kernelgen-capable',
     'D099': 'lint pass crashed',
 }
 

@@ -12,3 +12,4 @@ from . import aliasing  # noqa: F401
 from . import retrace  # noqa: F401
 from . import numeric  # noqa: F401
 from . import emit_coverage  # noqa: F401
+from . import kernelgen_coverage  # noqa: F401

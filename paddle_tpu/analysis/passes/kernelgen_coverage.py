@@ -1,0 +1,46 @@
+"""D016: fused sub-ops the Pallas codegen tier cannot lower.
+
+The kernelgen tier (ops/kernelgen) compiles each ``fused_elementwise``
+sub-program into generated Pallas kernels; a sub-op with no
+``KERNEL_RULES`` entry makes the WHOLE group fall back loudly to the
+reference replay at run time (``kernelgen.fallbacks`` counter, warn-once,
+``PT_STRICT_KERNELS=1`` raises).  This pass reports the same gap
+statically, per fused op, with sub-op names — the static face of
+``kernelgen.unsupported_sub_ops``.
+
+Severity is info: the replay fallback is bitwise-correct, just unfused —
+ci_smoke's strict-kernelgen zoo gate holds the bench programs to zero
+fallbacks so coverage regressions surface in CI rather than as perf
+regressions.
+"""
+from ..engine import register_pass
+
+__all__ = ['run']
+
+
+@register_pass('kernelgen_coverage')
+def run(ctx):
+    from ...ops import kernelgen
+    diags = []
+    seen = set()
+    for block in ctx.program.blocks:
+        for i, op in enumerate(block.ops):
+            if op.type != 'fused_elementwise':
+                continue
+            for sub_type in kernelgen.unsupported_sub_ops(op.attrs):
+                if sub_type in seen:
+                    continue
+                seen.add(sub_type)
+                diags.append(ctx.diag(
+                    'D016', 'info',
+                    'fused sub-op "%s" has no KERNEL_RULES entry: this '
+                    'fused_elementwise group falls back from its '
+                    'generated Pallas kernel (PT_KERNELGEN=1) to the '
+                    'reference replay' % sub_type,
+                    block=block, op=op, op_index=i,
+                    fixit='add a KERNEL_RULES entry '
+                          '(ops/kernelgen/rules.py), or set '
+                          'PT_KERNELGEN=0 to silence the runtime '
+                          'warning',
+                    pass_name='kernelgen_coverage'))
+    return diags

@@ -370,7 +370,24 @@ def _launch_signature(program, feed_vals, feed_names, fetch_names, steps,
                      for n in feed_names},
         fetch_set=fetch_names, steps=steps, check_nan=check_nan,
         scope=scope._serial, opt=_passes.config_token(),
-        emit=_emit.config_token())
+        emit=_emit.config_token(), kernelgen=_kg_token())
+
+
+def _kg_token():
+    from ..ops import kernelgen as _kg
+    return _kg.config_token()
+
+
+def _compose_fp_extra(engine_extra):
+    """Compose the emitter's fingerprint extra with kernelgen's.  When
+    kernelgen is off the engine extra passes through UNCHANGED (same
+    fingerprints as before the tier existed — disk artifacts stay
+    shared); when on, both paths gain the kernelgen component."""
+    from ..ops import kernelgen as _kg
+    if not _kg.enabled():
+        return engine_extra
+    kx = _kg.fingerprint_extra()
+    return (engine_extra, kx) if engine_extra is not None else kx
 
 
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
@@ -912,7 +929,8 @@ class Executor(object):
                 tuple((n,) + _feed_spec(feed_vals[n])
                       for n in sorted(feed_vals)),
                 fetch_names, self.check_nan, steps,
-                _passes.config_token(), _emit.config_token())
+                _passes.config_token(), _emit.config_token(),
+                _kg_token())
 
     def _gather_params(self, program, params_in, scope, base_key):
         import jax
@@ -1031,14 +1049,18 @@ class Executor(object):
             # the raw desc, a skipped pass changes the rewrite output)
             # emit-mode entries carry the emitter version + coverage set
             # in the key; fallback (and PT_EMIT=0) entries use extra=None
-            # so traced artifacts are SHARED across modes on disk
+            # so traced artifacts are SHARED across modes on disk.
+            # kernelgen (when on) composes its version + rule coverage
+            # into the extra on BOTH modes — generated kernels change
+            # what lowers on the traced path too
             fp = _cc.launch_fingerprint(
                 opt_program,
                 {n: _feed_spec(feed_vals[n]) for n in feed_names},
                 fetch_names, steps, self.check_nan, mesh=self.mesh,
                 param_specs={n: _feed_spec(v) for n, v in params.items()},
-                extra=engine.fingerprint_extra() if engine is not None
-                else None)
+                extra=_compose_fp_extra(
+                    engine.fingerprint_extra() if engine is not None
+                    else None))
             t_a0 = time.perf_counter()
             call, disk_tier = _cc.disk_cache().load(fp)
             if obs_on:
@@ -1085,7 +1107,8 @@ class Executor(object):
                         fetch_names, steps, self.check_nan,
                         mesh=self.mesh,
                         param_specs={n: _feed_spec(v)
-                                     for n, v in params.items()})
+                                     for n, v in params.items()},
+                        extra=_compose_fp_extra(None))
                 traced = jit_fn.trace(*args)
             t_cmid = time.perf_counter()
             lowered = traced.lower()

@@ -111,6 +111,9 @@ SCHEMA = {
         ('fetch_sync_s', ('sec', 'executor.fetch_sync_s')),
         ('kernel_fallbacks', ('int', 'kernel.fallbacks')),
         ('emitter_fallbacks', ('int', 'emitter.fallbacks')),
+        ('kernelgen_ops', ('int', 'kernelgen.ops')),
+        ('kernelgen_fallbacks', ('int', 'kernelgen.fallbacks')),
+        ('fused_adam_ms', ('extra',)),
         ('host_blocked_s', ('sec', 'executor.host_blocked_s')),
         ('nan_poll_lag_steps', ('int', 'nan_poll.lag_steps')),
         ('prefetch_upload_overlap_s', ('sec', 'prefetch.upload_overlap_s')),

@@ -18,3 +18,4 @@ from . import sampling  # noqa
 from . import ctc_crf  # noqa
 from . import int8  # noqa
 from . import fused  # noqa  (fused_elementwise from core/passes/fuse.py)
+from . import kernelgen  # noqa  (Pallas codegen tier + its emit rule)

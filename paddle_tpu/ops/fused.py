@@ -49,6 +49,12 @@ def _run_sub_op(ctx, sub, env, amp):
 
 @register('fused_elementwise')
 def fused_elementwise(ctx, ins, attrs):
+    from . import kernelgen as _kg
+    if _kg.enabled():
+        try:
+            return _kg.run_fused(ctx, ins, attrs)
+        except Exception as e:        # noqa: BLE001 — loud by contract
+            _kg.note_fallback(e)      # raises under PT_STRICT_KERNELS
     xs = ins.get('X', [])
     xs = xs if isinstance(xs, (list, tuple)) else [xs]
     env = dict(zip(attrs['arg_names'], xs))

@@ -1,0 +1,174 @@
+"""Pallas codegen tier: lower fused_elementwise sub-programs to
+generated kernels (docs/kernels.md).
+
+Entry points:
+
+* ``run_fused(ctx, ins, attrs)`` — kernel path (ops/fused.py tries this
+  first when ``PT_KERNELGEN=1``), RNG keys from the executor OpCtx's
+  ``sub_ctx`` fold-in.
+* the ``register_emit('fused_elementwise')`` rule — emit path: the
+  PR-12 memoized emitter dispatches fused groups here so generated
+  kernels key into the same per-signature memo, RNG keys from the
+  traced ``(base_key, stream)`` pair.
+
+Both paths fall back LOUDLY through ops/_fallback.py on any failure
+(``kernelgen.fallbacks`` counter, warn-once, ``PT_STRICT_KERNELS=1``
+raises naming the unsupported sub-op) to the bitwise-reference replay.
+
+Env vars: ``PT_KERNELGEN`` (default 0), ``PT_KERNELGEN_BLOCK`` (base
+block size, default 1024), ``PT_KERNELGEN_INTERPRET`` (force/forbid
+interpret mode; default: interpret unless the backend is TPU).
+"""
+import os
+
+from .rules import KERNEL_RULES, rule_names
+from .builder import (KernelgenUnsupported, clear_plans, plan_for,
+                      rng_rule_types)
+from ...core.registry import register_emit
+
+__all__ = ['KERNEL_RULES', 'KernelgenUnsupported', 'KERNELGEN_VERSION',
+           'enabled', 'config_token', 'fingerprint_extra', 'rule_names',
+           'run_fused', 'run_fused_emit', 'plan_for',
+           'clear_plan_cache', 'note_fallback', 'unsupported_sub_ops']
+
+# bump on any change to plan building / kernel emission semantics: it
+# feeds the compile-cache fingerprint and the emitter memo key
+KERNELGEN_VERSION = 1
+
+
+def enabled():
+    return os.environ.get('PT_KERNELGEN', '0') in ('1', 'true', 'True')
+
+
+def config_token():
+    """Launch-signature / emitter-memo component: is the tier on, and
+    which codegen generation is it."""
+    return ('kernelgen', 1 if enabled() else 0, KERNELGEN_VERSION)
+
+
+def fingerprint_extra():
+    """AOT disk-cache fingerprint component: version + rule coverage
+    (a new rule changes which sub-programs lower, so cached executables
+    from an older table must not be reused)."""
+    return ('kernelgen', KERNELGEN_VERSION, rule_names())
+
+
+def unsupported_sub_ops(attrs):
+    """Sub-op types of one fused_elementwise op with no KERNEL_RULES
+    entry (deduped, first-seen order) — the D016 lint surface."""
+    out, seen = [], set()
+    for sub in attrs.get('sub_ops') or ():
+        t = sub['type']
+        if t not in KERNEL_RULES and t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out
+
+
+def clear_plan_cache():
+    clear_plans()
+
+
+def note_fallback(exc):
+    """Count + route one kernelgen failure through the PR-6 loud
+    fallback contract (raises under PT_STRICT_KERNELS=1)."""
+    from .. import _fallback
+    from ...observability import metrics
+    metrics.counter('kernelgen.fallbacks').inc()
+    detail = ''
+    if isinstance(exc, KernelgenUnsupported):
+        detail = "unsupported sub-op '%s' (%s)" % (exc.sub_op, exc.why)
+    _fallback.kernel_fallback('kernelgen', exc, detail)
+
+
+def _in_avals(xs):
+    import numpy as np
+    import jax.numpy as jnp
+    return tuple((tuple(np.shape(x)), str(jnp.result_type(x)))
+                 for x in xs)
+
+
+def _keys_for(attrs, keyfn):
+    """One key per rng-kind sub-op, in sub-op order.  A pinned seed attr
+    overrides the stream key exactly as the impls themselves do."""
+    import jax
+    keys, si = [], 0
+    for sub in attrs['sub_ops']:
+        if sub['type'] in rng_rule_types():
+            seed = sub['attrs'].get('seed', 0)
+            keys.append(jax.random.key(seed) if seed
+                        else keyfn(si, sub))
+            si += 1
+    return tuple(keys)
+
+
+def _note_ok(plan):
+    from ...observability import metrics
+    metrics.counter('kernelgen.ops').inc()
+    metrics.counter('kernelgen.kernels').inc(plan.n_kernels)
+
+
+def _xs_of(ins):
+    xs = ins.get('X', [])
+    return list(xs) if isinstance(xs, (list, tuple)) else [xs]
+
+
+def run_fused(ctx, ins, attrs):
+    """Kernel-path entry: executor OpCtx RNG discipline
+    (ctx.sub_ctx(sub).rng() — the replay path's exact keys).  Ctxs
+    without sub-op streams (the lint abstract interpreter's InferCtx)
+    draw from ctx.rng() directly, exactly like the replay path's
+    hasattr guard — shapes are all that survive eval_shape anyway."""
+    xs = _xs_of(ins)
+    amp = bool(getattr(ctx, 'amp', False))
+    plan = plan_for(attrs, _in_avals(xs), amp)
+    keys = _keys_for(
+        attrs,
+        lambda si, sub: (ctx.sub_ctx(sub) if hasattr(ctx, 'sub_ctx')
+                         else ctx).rng())
+    outs = plan.fn(tuple(xs), keys)
+    _note_ok(plan)
+    return {'Out': list(outs)}
+
+
+def run_fused_emit(key, streams, amp, ins, attrs):
+    """Emit-path entry: EmitCtx RNG discipline (fold_in of the traced
+    base key with each sub-op's pinned stream — core/emit/emitter's
+    _op_streams order)."""
+    import jax
+    xs = _xs_of(ins)
+    plan = plan_for(attrs, _in_avals(xs), bool(amp))
+    streams = list(streams or ())
+    keys = _keys_for(
+        attrs, lambda si, sub: jax.random.fold_in(key, streams[si]))
+    outs = plan.fn(tuple(xs), keys)
+    _note_ok(plan)
+    return {'Out': list(outs)}
+
+
+def _fctx_parts(fctx):
+    """(key, streams, amp, mesh) from either the emitter's _FusedEmitCtx
+    (key/streams attrs) or a plain EmitCtx (_key/_stream slots)."""
+    key = getattr(fctx, 'key', None)
+    if key is None:
+        key = getattr(fctx, '_key', None)
+    streams = getattr(fctx, 'streams', None)
+    if streams is None:
+        st = getattr(fctx, '_stream', None)
+        streams = () if st is None else (st,)
+    return (key, tuple(streams), bool(getattr(fctx, 'amp', False)),
+            getattr(fctx, 'mesh', None))
+
+
+@register_emit('fused_elementwise')
+def _emit_fused(fctx, ins, attrs):
+    """Emitter dispatch: generated kernels when the tier is on, else
+    (or on loud fallback) the inline reference replay."""
+    key, streams, amp, mesh = _fctx_parts(fctx)
+    if enabled():
+        try:
+            return run_fused_emit(key, streams, amp, ins, attrs)
+        except Exception as e:        # noqa: BLE001 — loud by contract
+            note_fallback(e)          # raises under PT_STRICT_KERNELS
+    from ...core.emit.emitter import _replay_fused
+    return _replay_fused(ins, attrs, amp, mesh, key, streams)

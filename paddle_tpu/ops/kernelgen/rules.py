@@ -1,0 +1,228 @@
+"""KERNEL_RULES: the per-op lowering table for the Pallas codegen tier.
+
+Mirrors the ``register_emit`` pattern (core/emit/rules.py) one level
+down: where an emit rule replaces a kernel's *tracing*, a KERNEL_RULE
+describes how a fused sub-op lowers *inside* one generated Pallas kernel
+body, operating on flat 1-D block values instead of logical arrays.
+
+Three rule kinds:
+
+``ew``
+    Elementwise compute (activations, binaries, comparisons, optimizer
+    updates, fills).  The default body is the op's own registered kernel
+    impl applied to the flat block values — elementwise jnp expressions
+    are shape-agnostic lane-for-lane, so reusing the impl verbatim makes
+    bitwise parity with the replay path *by construction* rather than by
+    transcription.  Only ops whose impl reads a logical shape
+    (``label_smooth``'s class count, the ``fill_*`` lane counts) carry a
+    custom body.
+
+``layout``
+    Zero-flop glue (reshape/squeeze/unsqueeze/flatten/transpose/assign-
+    like).  No body: the plan builder either treats them as flat-order
+    identities inside the kernel or hoists order-changing transposes out
+    as XLA glue between kernel segments (see builder docstring).
+
+``rng``
+    Sub-ops that draw from ctx.rng.  The *draw* happens outside the
+    kernel (``draw(key, ins_avals, attrs)``) with exactly the impl's key
+    discipline, and the drawn array rides into the kernel as one more
+    tiled ref — bitwise identical to the replay path because the draw IS
+    the replay path's draw; only the surrounding arithmetic moves into
+    the kernel.
+
+Optimizer rules additionally declare ``aliases`` (output slot -> input
+slot) so the builder can donate Param/Moment refs through
+``input_output_aliases`` — the fused-Adam in-place update.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dtypes import jax_dtype
+from ...core.registry import get_op
+
+__all__ = ['KERNEL_RULES', 'KRule', 'rule_names']
+
+
+class KRule(object):
+    __slots__ = ('kind', 'body', 'draw', 'aliases', 'bcast_y',
+                 'shape_only')
+
+    def __init__(self, kind='ew', body=None, draw=None, aliases=None,
+                 bcast_y=False, shape_only=()):
+        self.kind = kind              # 'ew' | 'layout' | 'rng'
+        self.body = body              # None => op impl on flat blocks
+        self.draw = draw              # rng only: (key, avals, attrs) ->
+        self.aliases = aliases or {}  # out slot -> in slot (donation)
+        self.bcast_y = bcast_y        # binary op with _bcast_y(Y, axis)
+        self.shape_only = shape_only  # slots read for shape, not data
+
+
+KERNEL_RULES = {}
+
+
+def rule_names():
+    return tuple(sorted(KERNEL_RULES))
+
+
+def _r(name, **kw):
+    KERNEL_RULES[name] = KRule(**kw)
+
+
+class _NoRngCtx(object):
+    """ctx handed to passthrough impl bodies inside a kernel: any rng
+    draw at this point is a rule-table bug (rng ops must be kind='rng'
+    so their draw happens outside the kernel)."""
+    amp = False
+    mesh = None
+    is_infer = False
+
+    def rng(self, n=0):
+        raise RuntimeError('KERNEL_RULES bug: in-kernel ctx.rng draw — '
+                           'register the op as an rng rule')
+
+    def sub_ctx(self, sub):
+        return self
+
+
+NO_RNG_CTX = _NoRngCtx()
+
+
+class _FixedKeyCtx(object):
+    """ctx for out-of-kernel rng draws: .rng() returns the stream key the
+    caller derived (OpCtx.sub_ctx fold-in on the kernel path, EmitCtx
+    stream fold-in on the emit path) — same discipline as the replay."""
+    amp = False
+    mesh = None
+    is_infer = False
+
+    def __init__(self, key):
+        self._key = key
+
+    def rng(self, n=0):
+        return self._key
+
+
+# --------------------------------------------------- elementwise compute
+# Default bodies (impl passthrough).  _bcast_y binaries are flagged so the
+# builder can align Y through the same axis/reshape semantics the impl
+# would apply before the values reach the kernel.
+for _name in ('elementwise_add', 'elementwise_sub', 'elementwise_mul',
+              'elementwise_div', 'elementwise_pow', 'elementwise_max',
+              'elementwise_min', 'elementwise_mod',
+              'elementwise_floordiv', 'equal', 'not_equal', 'less_than',
+              'less_equal', 'greater_than', 'greater_equal'):
+    _r(_name, bcast_y=True)
+
+for _name in ('scale', 'cast', 'clip', 'relu', 'relu6', 'sigmoid',
+              'tanh', 'exp', 'log', 'sqrt', 'rsqrt', 'abs', 'square',
+              'sign', 'floor', 'ceil', 'round', 'reciprocal', 'pow',
+              'leaky_relu', 'elu', 'selu', 'softplus', 'softsign',
+              'brelu', 'hard_sigmoid', 'swish', 'stanh', 'logsigmoid',
+              'soft_relu', 'hard_shrink', 'softshrink', 'tanh_shrink',
+              'thresholded_relu', 'erf', 'sin', 'cos', 'increment',
+              'logical_and', 'logical_or', 'logical_not', 'logical_xor',
+              'assign', 'fill_zeros_like'):
+    _r(_name)
+
+
+def _label_smooth_body(ins, attrs, info):
+    # ops/tensor.py label_smooth, with the class count taken from the
+    # LOGICAL input shape (the flat block lost it)
+    x = ins['X']
+    eps = attrs.get('epsilon', 0.0)
+    if 'PriorDist' in ins:
+        return {'Out': (1 - eps) * x + eps * ins['PriorDist']}
+    return {'Out': (1 - eps) * x + eps / info.in_shape('X')[-1]}
+
+
+_r('label_smooth', body=_label_smooth_body)
+
+
+def _fill_constant_body(ins, attrs, info):
+    # ops/tensor.py fill_constant over this value's in-kernel lane count
+    from ..tensor import _fill_value
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
+    return {'Out': jnp.full((info.lanes,),
+                            _fill_value(attrs['value'], dtype),
+                            dtype=dtype)}
+
+
+_r('fill_constant', body=_fill_constant_body)
+
+
+def _fill_bsl_body(ins, attrs, info):
+    from ..tensor import _fill_value
+    dtype = jax_dtype(attrs.get('dtype', 'float32'))
+    return {'Out': jnp.full((info.lanes,),
+                            _fill_value(attrs['value'], dtype),
+                            dtype=dtype)}
+
+
+_r('fill_constant_batch_size_like', body=_fill_bsl_body,
+   shape_only=('Input',))
+
+# ------------------------------------------------------------ layout glue
+for _name in ('reshape', 'squeeze', 'unsqueeze', 'flatten', 'transpose'):
+    _r(_name, kind='layout')
+
+
+# ------------------------------------------------------------- rng rules
+def _dropout_draw(key, avals, attrs):
+    # exactly ops/nn.py dropout's mask derivation (keep.astype(x.dtype))
+    if attrs.get('is_test', False):
+        return None                      # no draw: pure ew on this path
+    p = attrs.get('dropout_prob', 0.5)
+    shape, dtype = avals.in_aval('X')
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return keep.astype(dtype)
+
+
+def _dropout_body(ins, attrs, info, draw):
+    x = ins['X']
+    p = attrs.get('dropout_prob', 0.5)
+    impl = attrs.get('dropout_implementation', 'downgrade_in_infer')
+    if draw is None:                     # is_test: impl passthrough
+        out = get_op('dropout').impl(NO_RNG_CTX, ins, attrs)
+        return out
+    mask = draw
+    out = x * mask
+    if impl == 'upscale_in_train' and p < 1.0:
+        out = out / (1.0 - p)
+    return {'Out': out, 'Mask': mask}
+
+
+_r('dropout', kind='rng', draw=_dropout_draw, body=_dropout_body)
+
+
+def _impl_draw(name):
+    # whole-op draw: the generator IS the op; in-kernel body is identity
+    def draw(key, avals, attrs):
+        return get_op(name).impl(_FixedKeyCtx(key), {}, attrs)['Out']
+    return draw
+
+
+for _name in ('uniform_random', 'gaussian_random',
+              'truncated_gaussian_random'):
+    _r(_name, kind='rng', draw=_impl_draw(_name), body=None)
+
+# ------------------------------------------------- optimizer updates
+# impl passthrough + donation aliases (the fused-Adam in-place story)
+_r('sgd', aliases={'ParamOut': 'Param'})
+_r('momentum', aliases={'ParamOut': 'Param', 'VelocityOut': 'Velocity'})
+_r('adam', aliases={'ParamOut': 'Param', 'Moment1Out': 'Moment1',
+                    'Moment2Out': 'Moment2', 'Beta1PowOut': 'Beta1Pow',
+                    'Beta2PowOut': 'Beta2Pow'})
+_r('adamax', aliases={'ParamOut': 'Param', 'MomentOut': 'Moment',
+                      'InfNormOut': 'InfNorm'})
+_r('adagrad', aliases={'ParamOut': 'Param', 'MomentOut': 'Moment'})
+_r('decayed_adagrad', aliases={'ParamOut': 'Param',
+                               'MomentOut': 'Moment'})
+_r('adadelta', aliases={'ParamOut': 'Param',
+                        'AvgSquaredGradOut': 'AvgSquaredGrad',
+                        'AvgSquaredUpdateOut': 'AvgSquaredUpdate'})
+_r('rmsprop', aliases={'ParamOut': 'Param', 'MeanSquareOut': 'MeanSquare',
+                       'MomentOut': 'Moment', 'MeanGradOut': 'MeanGrad'})
+_r('ftrl', aliases={'ParamOut': 'Param',
+                    'SquaredAccumOut': 'SquaredAccumulator',
+                    'LinearAccumOut': 'LinearAccumulator'})

@@ -212,11 +212,24 @@ def lookup_table(ctx, ins, attrs):
     return {'Out': out}
 
 
+def _fill_value(value, dtype):
+    """Normalize a fill value before it reaches jnp.full: a 64-bit numpy
+    scalar (program serialization hands these back) or an out-of-range
+    Python int would hit jax's x32 warn-and-truncate inside the trace.
+    Narrow HERE with explicit C-style wraparound so the truncation is
+    ours — same numerics, silent under warnings-as-error."""
+    try:
+        return np.asarray(value).astype(dtype)
+    except (OverflowError, TypeError, ValueError):
+        return value
+
+
 @register('fill_constant')
 def fill_constant(ctx, ins, attrs):
     dtype = jax_dtype(attrs.get('dtype', 'float32'))
     shape = [int(d) for d in attrs['shape']]
-    return {'Out': jnp.full(shape, attrs['value'], dtype=dtype)}
+    return {'Out': jnp.full(shape, _fill_value(attrs['value'], dtype),
+                            dtype=dtype)}
 
 
 @register('fill_constant_batch_size_like')
@@ -227,7 +240,8 @@ def fill_constant_batch_size_like(ctx, ins, attrs):
     out_idx = attrs.get('output_dim_idx', 0)
     shape[out_idx] = ref.shape[in_idx]
     dtype = jax_dtype(attrs.get('dtype', 'float32'))
-    return {'Out': jnp.full(shape, attrs['value'], dtype=dtype)}
+    return {'Out': jnp.full(shape, _fill_value(attrs['value'], dtype),
+                            dtype=dtype)}
 
 
 @register('fill_zeros_like')

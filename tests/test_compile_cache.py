@@ -290,14 +290,17 @@ def test_int64_sites_stay_silent():
     Covers both BENCH_r05-tail leak sites: the `jnp.full` inside
     fill_constant (ops/tensor.py) and the in-trace `.astype` path (the
     *_batch_size_like random ops went through convert_dtype, whose
-    int64 survives to `.astype` inside the trace).  Runs under BOTH
-    PT_OPT settings so the const-fold/fusion replay paths are pinned
-    silent too."""
+    int64 survives to `.astype` inside the trace).  The np.int64 VALUE
+    case pins the _fill_value normalization (a 64-bit numpy scalar from
+    program serialization must not reach jnp.full raw).  Runs over the
+    full PT_OPT x PT_EMIT matrix so const-fold/fusion replay AND the
+    direct-emitter paths are pinned silent too."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         with fluid.unique_name.guard():
             x = fluid.layers.data('x', shape=[4], dtype='float32')
             c = fluid.layers.fill_constant([2, 2], 'int64', 7)
+            c64 = fluid.layers.fill_constant([2], 'int64', np.int64(9))
             c2 = fluid.layers.cast(c, 'int64') + 1  # fold+fuse fodder
             casted = x.astype('int64')
             topv, topi = fluid.layers.topk(x, k=2)
@@ -309,18 +312,22 @@ def test_int64_sites_stay_silent():
                 attrs={'shape': [-1, 4], 'dtype': 'int64',
                        'min': 0.0, 'max': 9.0})
     for pt_opt in ('1', '0'):
-        os.environ['PT_OPT'] = pt_opt
-        try:
-            exe, scope = fluid.Executor(), fluid.Scope()
-            with warnings.catch_warnings():
-                warnings.simplefilter('error', UserWarning)
-                with fluid.scope_guard(scope):
-                    exe.run(startup)
-                    cv, c2v, iv, tv, rv = exe.run(
-                        main, feed={'x': np.ones((3, 4), 'float32')},
-                        fetch_list=[c, c2, topi, casted, rnd])
-        finally:
-            os.environ.pop('PT_OPT', None)
-        assert cv.ravel()[0] == 7 and c2v.ravel()[0] == 8
-        assert iv.dtype.kind == 'i' and tv.dtype.kind == 'i'
-        assert rv.dtype.kind == 'i' and rv.shape == (3, 4)
+        for pt_emit in ('1', '0'):
+            os.environ['PT_OPT'] = pt_opt
+            os.environ['PT_EMIT'] = pt_emit
+            try:
+                exe, scope = fluid.Executor(), fluid.Scope()
+                with warnings.catch_warnings():
+                    warnings.simplefilter('error', UserWarning)
+                    with fluid.scope_guard(scope):
+                        exe.run(startup)
+                        cv, c64v, c2v, iv, tv, rv = exe.run(
+                            main, feed={'x': np.ones((3, 4), 'float32')},
+                            fetch_list=[c, c64, c2, topi, casted, rnd])
+            finally:
+                os.environ.pop('PT_OPT', None)
+                os.environ.pop('PT_EMIT', None)
+            assert cv.ravel()[0] == 7 and c2v.ravel()[0] == 8
+            assert c64v.ravel()[0] == 9 and c64v.dtype.kind == 'i'
+            assert iv.dtype.kind == 'i' and tv.dtype.kind == 'i'
+            assert rv.dtype.kind == 'i' and rv.shape == (3, 4)

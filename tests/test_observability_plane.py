@@ -496,7 +496,8 @@ def test_telemetry_snapshot_sections_match_schema():
     tel = obs.telemetry_snapshot(
         'bench', extra={'platform': 'cpu', 'device_kind': 'cpu',
                         'program_op_count_raw': 10,
-                        'program_op_count_opt': 7})
+                        'program_op_count_opt': 7,
+                        'fused_adam_ms': 1.5})
     assert list(tel) == obs_export.schema_keys('bench')
     obs.histogram('serving.latency_ms').observe(5.0)
     obs.counter('serving.admitted').inc(0)

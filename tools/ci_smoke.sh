@@ -171,6 +171,99 @@ if [ "$emit_zoo_rc" -ne 0 ]; then
     echo "ci_smoke: strict-emit zoo gate FAILED (rc=$emit_zoo_rc)"
 fi
 
+echo "== ci_smoke: strict-kernelgen coverage =="
+# Pallas codegen gate (docs/kernels.md): the bench transformer and a
+# fused-Adam program must train end-to-end under PT_KERNELGEN=1
+# PT_STRICT_KERNELS=1 — every fused_elementwise group lowers through a
+# generated kernel, zero fallbacks (a sub-op losing its KERNEL_RULES
+# entry raises here, naming the sub-op, instead of silently un-fusing
+# the optimizer step).  The optimized programs must also carry zero
+# D016 lint findings — the static face of the same contract.
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_KERNELGEN=1 \
+    PT_STRICT_KERNELS=1 PT_CACHE=0 python - <<'EOF'
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.core import passes
+from paddle_tpu.models import transformer as tr
+
+
+def check_d016(main, fetch_names, label):
+    opt, _ = passes.optimize_program(main, tuple(fetch_names))
+    res = opt.lint(fetch_list=list(fetch_names))
+    d16 = [d for d in res if d.code == 'D016']
+    if d16:
+        sys.exit('ci_smoke: KERNELGEN GAP in %s: %s'
+                 % (label, d16[0].render()))
+
+
+def counters():
+    c = obs.counters()
+    return (c.get('kernelgen.ops') or 0,
+            c.get('kernelgen.fallbacks') or 0,
+            c.get('kernel.fallbacks') or 0)
+
+
+# 1. bench transformer (smoke shapes), AMP + dropout, 2 steps
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard():
+        out = tr.build(src_vocab=256, trg_vocab=256, max_len=16,
+                       n_layer=2, n_head=2, d_model=32, d_inner=64,
+                       dropout=0.1, use_flash=False)
+main.set_amp(True)
+check_d016(main, (out['loss'].name,), 'bench transformer')
+exe, scope = fluid.Executor(), fluid.Scope()
+rng = np.random.RandomState(0)
+feed = tr.synthetic_batch(rng, 2, 16, 256)
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    for _ in range(2):
+        loss, = exe.run(main, feed=feed, fetch_list=[out['loss']])
+        if not np.isfinite(np.asarray(loss)).all():
+            sys.exit('ci_smoke: non-finite loss under PT_KERNELGEN=1')
+ops, kg_fb, k_fb = counters()
+if ops < 1:
+    sys.exit('ci_smoke: kernelgen.ops=%r — PT_KERNELGEN=1 but no fused '
+             'group lowered through a generated kernel' % ops)
+print('ci_smoke: transformer trained strict-kernelgen '
+      '(%d groups via generated kernels)' % ops)
+
+# 2. fused-Adam program: the whole optimizer step must survive strict
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard():
+        x = fluid.layers.data('x', shape=[64], dtype='float32')
+        h = fluid.layers.fc(x, 64, act='relu')
+        y = fluid.layers.fc(h, 64)
+        loss = fluid.layers.reduce_mean(y * y)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+check_d016(main, (loss.name,), 'fused-Adam program')
+exe, scope = fluid.Executor(), fluid.Scope()
+feed = {'x': np.random.RandomState(1).randn(8, 64).astype('float32')}
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    for _ in range(2):
+        exe.run(main, feed=feed, fetch_list=[loss])
+ops2, kg_fb, k_fb = counters()
+if ops2 <= ops:
+    sys.exit('ci_smoke: fused-Adam program lowered no generated kernels '
+             '(kernelgen.ops %r -> %r)' % (ops, ops2))
+if kg_fb or k_fb:
+    sys.exit('ci_smoke: %d kernelgen / %d kernel fallback(s) under '
+             'PT_STRICT_KERNELS=1 — fallback accounting is broken'
+             % (kg_fb, k_fb))
+print('ci_smoke: fused-Adam trained strict-kernelgen '
+      '(%d groups total, zero fallbacks)' % ops2)
+EOF
+kg_zoo_rc=$?
+if [ "$kg_zoo_rc" -ne 0 ]; then
+    echo "ci_smoke: strict-kernelgen gate FAILED (rc=$kg_zoo_rc)"
+fi
+
 echo "== ci_smoke: ruff =="
 # style/bug gate with the committed ruff.toml; the container image may
 # not ship ruff — skip with a notice rather than fail the smoke
@@ -387,6 +480,7 @@ tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'opt_pass_ms', 'opt_ops_fused', 'stall_count',
                 'prefetch_starvation_s', 'fetch_sync_s',
                 'kernel_fallbacks', 'emitter_fallbacks',
+                'kernelgen_ops', 'kernelgen_fallbacks', 'fused_adam_ms',
                 'host_blocked_s', 'nan_poll_lag_steps',
                 'prefetch_upload_overlap_s']
 tel_missing = [k for k in tel_expected if k not in tel]
@@ -423,6 +517,22 @@ if tel['kernel_fallbacks'] > 0:
     sys.exit('ci_smoke: %d kernel fallback(s) — a pallas kernel silently '
              'degraded to its composed path (PT_STRICT_KERNELS=1 shows '
              'the raw error)' % tel['kernel_fallbacks'])
+# kernelgen gate, bench face (docs/kernels.md): PT_KERNELGEN=1 is the
+# bench default, so generated kernels must actually engage and never
+# silently un-fuse back to the replay
+for label, t in (('cold', tel), ('warm', rec2['telemetry'])):
+    if t['kernelgen_fallbacks'] > 0:
+        sys.exit('ci_smoke: %s bench reports %d kernelgen fallback(s) — '
+                 'a fused group silently degraded from its generated '
+                 'kernel to the replay (PT_STRICT_KERNELS=1 shows the '
+                 'raw error)' % (label, t['kernelgen_fallbacks']))
+if not tel['kernelgen_ops'] > 0:
+    sys.exit('ci_smoke: cold bench kernelgen_ops=%r — PT_KERNELGEN=1 is '
+             'the bench default but no fused group lowered through a '
+             'generated kernel' % tel['kernelgen_ops'])
+if tel['fused_adam_ms'] is not None and not tel['fused_adam_ms'] > 0:
+    sys.exit('ci_smoke: fused_adam_ms=%r — the fused-Adam micro-bench '
+             'did not produce a timing' % tel['fused_adam_ms'])
 for label, t in (('cold', tel), ('warm', rec2['telemetry'])):
     if t['emitter_fallbacks'] > 0:
         sys.exit('ci_smoke: %s bench reports %d emitter fallback(s) — the '
@@ -483,6 +593,7 @@ fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$emit_zoo_rc" -eq 0 ] && \
+    [ "$kg_zoo_rc" -eq 0 ] && \
     [ "$soak_rc" -eq 0 ] && \
     [ "$resume_rc" -eq 0 ] && [ "$async_rc" -eq 0 ] && \
     [ "$pod_rc" -eq 0 ] && \
