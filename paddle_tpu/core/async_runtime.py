@@ -170,12 +170,15 @@ class DeferredNanVerdict(object):
     ``logical_and`` (async, never blocks); ``poll`` performs the ONE host
     sync per window and resets it.  With ``poll_every=1`` every push is
     immediately due, reproducing the synchronous per-launch read."""
-    __slots__ = ('poll_every', '_ok', '_pending')
+    __slots__ = ('poll_every', '_ok', '_pending', '_start',
+                 'last_window_start')
 
     def __init__(self, poll_every=1):
         self.poll_every = max(1, int(poll_every))
         self._ok = None
         self._pending = 0
+        self._start = None           # run counter of the window's first step
+        self.last_window_start = None  # ... of the last polled window
 
     @property
     def pending_steps(self):
@@ -184,9 +187,13 @@ class DeferredNanVerdict(object):
         gauge)."""
         return self._pending
 
-    def push(self, ok, steps=1):
+    def push(self, ok, steps=1, start=None):
+        """``start`` is the run counter of the launch's first step — kept
+        so a trip can tell forensics exactly which window to replay."""
         if self._ok is None:
             self._ok = ok
+            if start is not None:
+                self._start = int(start)
         else:
             import jax.numpy as jnp
             self._ok = jnp.logical_and(self._ok, ok)
@@ -207,8 +214,10 @@ class DeferredNanVerdict(object):
         window = self._pending
         with host_block('nan_poll', steps=window):
             ok = bool(self._ok)
+        self.last_window_start = self._start
         self._ok = None
         self._pending = 0
+        self._start = None
         if _obs.enabled():
             _obs.metrics.counter('nan_poll.polls').inc()
             _obs.metrics.gauge('nan_poll.lag_steps').set(0)
@@ -224,5 +233,6 @@ class DeferredNanVerdict(object):
             _obs.metrics.counter('nan_poll.window_resets').inc()
         self._ok = None
         self._pending = 0
+        self._start = None
         if _obs.enabled():
             _obs.metrics.gauge('nan_poll.lag_steps').set(0)

@@ -459,7 +459,11 @@ class EmitEngine(object):
         import jax.numpy as jnp
         import jax.lax as lax
         dmask = self._dmasks.get(id(op))
-        if dmask is None:   # op object outside the analyzed program
+        if dmask is None or getattr(ectx, 'forensic', None) is not None:
+            # op object outside the analyzed program — or a forensic
+            # probe lowering, where every output must materialize so the
+            # per-op finite probes have something to look at (dead-op
+            # elision would hide exactly the op being hunted)
             dmask = {s: tuple(True for _ in names)
                      for s, names in op.outputs.items()}
         if op.type not in EFFECTFUL_OPS and \

@@ -197,12 +197,78 @@ def _amp_match_ins(op_type, ins):
             for s, v in ins.items()}
 
 
+class ForensicProbes(object):
+    """Trace-time collector for the per-op finite-probe lowering
+    (train/forensics.py, PT_FORENSIC).
+
+    While a forensic lowering traces, every op's inexact outputs get a
+    3-vector probe [all_finite, nonfinite_count, max_abs_finite] written
+    into the active environment under a reserved ``__fprobe_K__`` name.
+    Riding the environment is what lets forward-op probes cross the vjp
+    boundary as ordinary primal outputs (stop_gradient'd, zero
+    cotangent) instead of leaking tracers.  ``meta`` records, in
+    allocation order, which (op position, op type, output var,
+    source_loc) each probe slot describes — the python-side key that
+    turns the fetched [N, 3] stack back into a named verdict."""
+
+    PREFIX = '__fprobe_'
+
+    def __init__(self):
+        self.meta = []
+        self.env = None    # the environment dict currently being traced
+
+    def begin(self):
+        self.meta = []
+        self.env = None
+
+    def names(self):
+        return ['%s%d__' % (self.PREFIX, i) for i in range(len(self.meta))]
+
+    def note(self, pos, op_type, var_name, source_loc, val):
+        import jax
+        import jax.numpy as jnp
+        if self.env is None or not (
+                hasattr(val, 'dtype') and
+                jnp.issubdtype(val.dtype, jnp.inexact)):
+            return
+        name = '%s%d__' % (self.PREFIX, len(self.meta))
+        try:
+            loc = '%s:%s' % tuple(source_loc) if source_loc else ''
+        except TypeError:
+            loc = str(source_loc)
+        self.meta.append({'pos': int(pos), 'op_type': op_type,
+                          'var': var_name, 'source_loc': loc})
+        fin = jnp.isfinite(val)
+        mag = jnp.abs(val).astype(jnp.float32)
+        probe = jnp.stack([
+            jnp.all(fin).astype(jnp.float32),
+            jnp.sum(jnp.logical_not(fin)).astype(jnp.float32),
+            jnp.max(jnp.where(fin, mag, jnp.zeros_like(mag)), initial=0.0),
+        ])
+        self.env[name] = jax.lax.stop_gradient(probe)
+
+    def note_op(self, env, pos, op):
+        """Probe every inexact output `op` just wrote into `env`."""
+        self.env = env
+        loc = getattr(op, 'source_loc', None)
+        for nm in op.output_names():
+            v = env.get(nm)
+            if v is not None:
+                self.note(pos, op.type, nm, loc, v)
+
+
 def _exec_ops(ops, op_offset, env, ectx, program):
     """Trace a run of registered ops into `env` (the heart of lowering).
     Contiguous runs of ops sharing a recompute_id execute under
     jax.checkpoint: their activations are rematerialized in the backward
     pass instead of saved (see framework.recompute_scope)."""
     import jax
+    if getattr(ectx, 'forensic', None) is not None:
+        # forensic probe mode: no jax.checkpoint recompute grouping —
+        # probe values written inside a checkpointed group could never
+        # escape it to the step function's outputs
+        _exec_ops_plain(ops, op_offset, env, ectx, program)
+        return
     i = 0
     n = len(ops)
     while i < n:
@@ -247,14 +313,28 @@ def _exec_ops_plain(ops, op_offset, env, ectx, program):
     # instead of per-op kernel tracing.  Control flow stays native (its
     # bodies re-enter here, engine in tow).
     engine = getattr(ectx, 'emit_engine', None)
+    fx = getattr(ectx, 'forensic', None)
     for i, op in enumerate(ops):
+        if fx is not None:
+            # point the collector at the live env BEFORE dispatch so
+            # impls that probe internally (fused_elementwise sub-ops)
+            # write their probes where the step outputs can see them
+            fx.env = env
         if op.type in _CONTROL_FLOW:
             from . import control_flow_exec
             control_flow_exec.exec_control_flow_op(
                 op, env, ectx, op_offset + i, program)
+            if fx is not None:
+                fx.note_op(env, op_offset + i, op)
             continue
         if engine is not None:
             engine.run_op(op, op_offset + i, env, ectx)
+            if fx is not None:
+                # emit mode probes at op granularity (the memoized fns
+                # never see the collector); sub-program granularity for
+                # fused groups comes from the plain-trace forensic
+                # runner, which is what train/forensics.py lowers
+                fx.note_op(env, op_offset + i, op)
             continue
         impl = registry.get_op(op.type).impl
         use_amp = amp and op.type in _AMP_OPS
@@ -299,6 +379,10 @@ def _exec_ops_plain(ops, op_offset, env, ectx, program):
                             val.dtype, jnp.floating):
                     val = lax.stop_gradient(val)
                 env[name] = val
+        if fx is not None and op.type != 'fused_elementwise':
+            # fused groups probe themselves at sub-program granularity
+            # (ops/fused.py) — an outer probe would double-count
+            fx.note_op(env, op_offset + i, op)
 
 
 def _analyze(block, feed_names, fetch_names):
@@ -392,7 +476,7 @@ def _compose_fp_extra(engine_extra):
 
 def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
            out_shardings_for=None, check_nan=False, steps=None,
-           emit_engine=None):
+           emit_engine=None, forensic=None):
     """Build the jitted step function for (program, feeds, fetches).
     check_nan compiles a fused all-finite flag over fetches+updates INTO
     the executable (per-array host checks measured >30x slower through
@@ -405,9 +489,19 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
     the (donated) carry, per-step RNG derived by folding `counter + i`
     into the program seed (bitwise-identical to K sequential runs, which
     consume counters counter..counter+K-1), fetches stacked per step,
-    and the check_nan flag AND-reduced across the scan."""
+    and the check_nan flag AND-reduced across the scan.
+
+    forensic=ForensicProbes() builds the PT_FORENSIC probe variant: the
+    step function additionally returns a stacked [N, 3] array of per-op
+    finite probes (see ForensicProbes) whose rows line up with
+    ``forensic.meta`` after the first trace.  One-step lowerings only —
+    forensic replay walks the window a step at a time by design."""
     import jax
     import jax.numpy as jnp
+
+    if forensic is not None and steps is not None:
+        raise ValueError('forensic lowering is single-step only '
+                         '(steps must be None)')
 
     # Static analysis at the lowering-cache miss (SSA-graph race
     # detection analog, SURVEY §2.8, grown into the full pt-lint pass
@@ -445,6 +539,9 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                                 amp=getattr(program, '_amp', False))
         if emit_engine is not None:
             ectx.emit_engine = emit_engine
+        if forensic is not None:
+            forensic.begin()   # a retrace must not duplicate probe meta
+            ectx.forensic = forensic
         env0 = {}
         env0.update(feeds)
         env0.update(params)
@@ -496,7 +593,13 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                 env2 = dict(rest)
                 env2.update(d)
                 _exec_ops(ops[:bw_idx], 0, env2, ectx, program)
-                return {k: v for k, v in env2.items() if k in fw_keep}
+                # probe entries must cross the vjp boundary as primal
+                # outputs — they are not in any static keep-set (their
+                # names are allocated during this very trace)
+                return {k: v for k, v in env2.items()
+                        if k in fw_keep or (
+                            forensic is not None and
+                            k.startswith(ForensicProbes.PREFIX))}
 
             env_out, pullback = jax.vjp(fw, diff)
             if loss_name not in env_out:
@@ -520,6 +623,12 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                 if slot == 'Grads':
                     for p, gname in zip(pnames, names):
                         env[gname] = grads[p]
+                        if forensic is not None:
+                            forensic.env = env
+                            forensic.note(
+                                bw_idx, _BACKWARD_OP, gname,
+                                getattr(bw_op, 'source_loc', None),
+                                env[gname])
                 elif slot == 'LossGrad':
                     env[names[0]] = jnp.ones_like(env[loss_name])
             _exec_ops(ops[bw_idx + 1:], bw_idx + 1, env, ectx, program)
@@ -530,13 +639,22 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                 raise ValueError('fetch var %s was never computed' % n)
             fetches.append(env[n])
         updates = {n: env[n] for n in writeback if n in env}
+        probes = None
+        if forensic is not None:
+            vals = [env[n] for n in forensic.names() if n in env]
+            probes = (jnp.stack(vals) if vals
+                      else jnp.zeros((0, 3), jnp.float32))
         if not check_nan:
+            if forensic is not None:
+                return fetches, updates, probes
             return fetches, updates
         ok = jnp.asarray(True)
         for v in itertools.chain(fetches, updates.values()):
             if hasattr(v, 'dtype') and jnp.issubdtype(v.dtype,
                                                       jnp.inexact):
                 ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(v)))
+        if forensic is not None:
+            return fetches, updates, ok, probes
         return fetches, updates, ok
 
     if steps is None:
@@ -709,6 +827,7 @@ class Executor(object):
         if window:
             e = RuntimeError(_async.DEFERRED_TRIP_MSG % window)
             e.nan_window_steps = window
+            e.nan_window_start = self._nan.last_window_start
             raise e
 
     def reset_nan_window(self):
@@ -744,13 +863,35 @@ class Executor(object):
         """Restore counters captured by `rng_state`.  Live base_keys with
         a matching signature are overwritten in place (in-process
         rollback); unseen signatures are parked and consumed on their
-        first run (fresh-process resume)."""
+        first run (fresh-process resume).  A live stream ABSENT from the
+        snapshot had not run when the checkpoint was taken — it rewinds
+        to 0, so a rollback to a pre-stream checkpoint replays the exact
+        counters (dropout masks, fault windows) the original run drew."""
         state = {k: int(v) for k, v in (state or {}).items()}
+        consumed = set()
         for key in list(self._run_counter):
             k = self._stream_key(key[2], key[3])
             if k in state:
-                self._run_counter[key] = state.pop(k)
-        self._pending_counters.update(state)
+                self._run_counter[key] = state[k]
+                consumed.add(k)
+            else:
+                self._run_counter[key] = 0
+        self._pending_counters = {k: v for k, v in state.items()
+                                  if k not in consumed}
+
+    def stream_counter(self, feed_names, fetch_names):
+        """The NEXT run counter a launch with this (feed names, fetch
+        names) signature would consume.  Forensic replay (train/
+        forensics.py) uses this right after a checkpoint restore to
+        re-derive the exact per-step RNG keys the condemned window used."""
+        k = self._stream_key(tuple(feed_names), tuple(fetch_names))
+        best = None
+        for key, v in self._run_counter.items():
+            if self._stream_key(key[2], key[3]) == k:
+                best = int(v) if best is None else max(best, int(v))
+        if best is None:
+            best = int(self._pending_counters.get(k, 0))
+        return best
 
     def _resolve_fetch(self, fetch_list):
         names = []
@@ -1288,7 +1429,7 @@ class Executor(object):
             # it into a running AND (async, no host read) and only a DUE
             # window forces the one host sync.  nan_poll=1 makes every
             # launch due — bit-for-bit the old per-launch bool(ok) read.
-            self._nan.push(result[2], steps or 1)
+            self._nan.push(result[2], steps or 1, start=counter)
             if self._nan.due():
                 window = self._nan.poll()
                 if window:
@@ -1358,10 +1499,12 @@ class Executor(object):
                 zip(fetch_names, fetches), updates.items()))
         except RuntimeError as e:
             e.nan_window_steps = window
+            e.nan_window_start = self._nan.last_window_start
             raise
         if window > 1:
             e = RuntimeError(_async.DEFERRED_TRIP_MSG % window)
             e.nan_window_steps = window
+            e.nan_window_start = self._nan.last_window_start
             raise e
 
     @staticmethod

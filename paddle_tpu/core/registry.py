@@ -100,6 +100,7 @@ class InferCtx(object):
     is_infer = True
     mesh = None
     amp = False
+    forensic = None
 
     def __init__(self, op=None):
         self.op = op
@@ -114,9 +115,13 @@ class ExecCtx(object):
     the executor's device mesh (None single-chip): mesh-aware ops like
     ring_attention pick their collective strategy from it.  `amp` is the
     program's bf16 mixed-precision flag — the fused_elementwise kernel
-    replays the executor's per-op AMP policy and needs it in-band."""
+    replays the executor's per-op AMP policy and needs it in-band.
+    `forensic` (default None) is a ForensicProbes collector attached by a
+    PT_FORENSIC lowering — op impls that hide internal structure (the
+    fused_elementwise replay) probe their sub-ops through it."""
 
     is_infer = False
+    forensic = None
 
     def __init__(self, base_key, mesh=None, amp=False):
         self.base_key = base_key
@@ -152,6 +157,10 @@ class OpCtx(object):
     @property
     def amp(self):
         return self._exec.amp
+
+    @property
+    def forensic(self):
+        return getattr(self._exec, 'forensic', None)
 
     def rng(self, n=0):
         # op streams are 1-based: stream 0 off the run key is reserved for

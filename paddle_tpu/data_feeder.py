@@ -26,10 +26,13 @@ import numpy as np
 from .core.framework import Variable, default_main_program
 from .core.lod import create_lod_tensor
 from .core.dtypes import convert_dtype
+from .core.retry import retry_with_backoff
 from . import observability as _obs
+from .observability import flight as _flight
 from .testing import faults as _faults
 
-__all__ = ['DataFeeder', 'FeedPrefetcher', 'FeedBucketer']
+__all__ = ['DataFeeder', 'FeedPrefetcher', 'FeedBucketer',
+           'SampleQuarantine']
 
 
 def _default_boundaries():
@@ -322,6 +325,22 @@ class FeedPrefetcher(object):
                 continue
         return False
 
+    def _read_next(self):
+        """One reader pull behind the shared transient-IO retry policy
+        (core/retry.py): a flaky reader — an NFS blip, an object-store
+        hiccup, the deterministic ``feed_read`` fault site — is absorbed
+        with bounded backoff instead of killing the trainer.
+        StopIteration propagates immediately: exhaustion is not an
+        error."""
+        def read():
+            if _faults.any_active():
+                _faults.maybe_fail('feed_read')
+            return next(self._src)
+        return retry_with_backoff(read, base_delay=0.01, max_delay=0.2,
+                                  retry_on=(OSError,),
+                                  give_up_on=(StopIteration,),
+                                  name='feed_read')
+
     def _worker(self):
         try:
             skipped = 0
@@ -329,7 +348,7 @@ class FeedPrefetcher(object):
                 if self._stop.is_set():
                     return
                 try:
-                    next(self._src)
+                    self._read_next()
                 except StopIteration:
                     self._put(('done', None, None))
                     return
@@ -337,9 +356,13 @@ class FeedPrefetcher(object):
             if skipped and _obs.enabled():
                 _obs.metrics.counter('prefetch.skipped_steps').inc(skipped)
             buf = []
-            for f in self._src:
+            while True:
                 if self._stop.is_set():
                     return
+                try:
+                    f = self._read_next()
+                except StopIteration:
+                    break
                 buf.append(f)
                 if len(buf) == self._steps:
                     if _faults.any_active():
@@ -497,3 +520,180 @@ class DataFeeder(object):
             for batch in reader():
                 yield self.feed(batch)
         return _reader
+
+
+def default_sample_index(step, row, batch_size):
+    """Default (step, batch row) -> reader sample index mapping: a
+    single-pass sequential reader emitting fixed-size batches.  Epoch
+    loops or shuffled readers must supply their own ``index_of`` so
+    quarantined indices stay stable across passes."""
+    return int(step) * int(batch_size) + int(row)
+
+
+class SampleQuarantine(object):
+    """Persistent set of condemned reader sample indices.
+
+    When forensics (train/forensics.py) names the batch rows that
+    poisoned a step, `add` records their reader indices here and
+    `apply` keeps them out of every future feed by replacing each
+    quarantined row with the nearest healthy row of the same batch —
+    shapes stay fixed, so no retrace, and a reference run with the same
+    quarantine pre-seeded builds bitwise-identical feeds.  The set rides
+    checkpoint META (`state`/`restore`, train/checkpoint.py) so a
+    resumed run never re-trips on a sample it already condemned; an
+    optional ``path`` additionally persists it as a standalone JSON file
+    for inspection and cross-job sharing.
+    """
+
+    def __init__(self, path=None, index_of=None):
+        self._set = set()
+        self.path = path
+        self.index_of = index_of or default_sample_index
+        if path and os.path.exists(path):
+            self._load()
+
+    def __len__(self):
+        return len(self._set)
+
+    def __contains__(self, idx):
+        return int(idx) in self._set
+
+    def state(self):
+        """JSON-able snapshot (sorted sample indices)."""
+        return sorted(self._set)
+
+    def restore(self, state):
+        """Merge a snapshot back in — union, never shrink: an index
+        condemned after the snapshot was taken stays condemned."""
+        self._set.update(int(i) for i in (state or ()))
+        if _obs.enabled():
+            _obs.metrics.gauge('feed.quarantine_size').set(len(self._set))
+
+    def add(self, indices, reason='forensics'):
+        """Quarantine reader indices; counts only the NEW ones into
+        ``feed.quarantined`` and persists when a path is set."""
+        fresh = [int(i) for i in indices if int(i) not in self._set]
+        if not fresh:
+            return 0
+        self._set.update(fresh)
+        if _obs.enabled():
+            _obs.metrics.counter('feed.quarantined').inc(len(fresh))
+            _obs.metrics.gauge('feed.quarantine_size').set(len(self._set))
+        _flight.record('feed.quarantine', indices=fresh, reason=reason,
+                       total=len(self._set))
+        if self.path:
+            self._persist()
+        return len(fresh)
+
+    def _load(self):
+        import json
+
+        def read():
+            with open(self.path) as f:
+                return json.load(f)
+        try:
+            data = retry_with_backoff(read, retry_on=(OSError,),
+                                      give_up_on=(FileNotFoundError,),
+                                      name='quarantine_read')
+        except (FileNotFoundError, ValueError):
+            return
+        self.restore(data.get('indices', ()))
+
+    def _persist(self):
+        import json
+        payload = json.dumps({'indices': self.state()})
+
+        def write():
+            tmp = self.path + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+        retry_with_backoff(write, retry_on=(OSError,),
+                           name='quarantine_write')
+
+    # ---------------------------------------------------------- feed-time
+    def _clean_rows(self, step, batch):
+        """(quarantined rows, replacement row per quarantined row) for one
+        step's batch.  Each bad row maps to the NEAREST healthy row
+        (preferring earlier), deterministically."""
+        bad = [r for r in range(batch)
+               if self.index_of(step, r, batch) in self._set]
+        if not bad or len(bad) == batch:
+            # nothing to do — or nothing healthy left to substitute
+            # (the whole batch is condemned; the caller's skip-batch
+            # rung handles it)
+            if bad and _obs.enabled():
+                _obs.metrics.counter('feed.quarantine_saturated').inc()
+            return ([], {}) if len(bad) != batch else (bad, {})
+        bad_set = set(bad)
+        repl = {}
+        for r in bad:
+            for d in range(1, batch):
+                for cand in (r - d, r + d):
+                    if 0 <= cand < batch and cand not in bad_set:
+                        repl[r] = cand
+                        break
+                if r in repl:
+                    break
+        return bad, repl
+
+    def apply(self, feed, step0, steps=1):
+        """Return (feed', replaced_count) with quarantined rows replaced.
+
+        Handles the three launch feed forms the executor accepts: one
+        per-step dict (batch axis 0), a stacked superbatch dict (step
+        axis 0, batch axis 1), or a list of per-step dicts.  Every array
+        of the batch's leading size is substituted — labels included —
+        so the replacement row is a fully-consistent duplicate sample."""
+        if not self._set:
+            return feed, 0
+        if isinstance(feed, (list, tuple)):
+            out = []
+            n = 0
+            for i, f in enumerate(feed):
+                f2, k = self.apply(f, int(step0) + i, 1)
+                out.append(f2)
+                n += k
+            return (list(out) if isinstance(feed, list) else tuple(out)), n
+        arrays = {k: np.asarray(v) for k, v in feed.items()}
+        if not arrays:
+            return feed, 0
+        stacked = int(steps) > 1
+        dims = [a.shape[1] if stacked else a.shape[0]
+                for a in arrays.values()
+                if a.ndim >= (2 if stacked else 1)]
+        if not dims or len(set(dims)) != 1:
+            return feed, 0   # no consistent batch axis to substitute on
+        batch = dims[0]
+        replaced = 0
+        out = dict(feed)
+        steps_n = int(steps) if stacked else 1
+        for si in range(steps_n):
+            step = int(step0) + si
+            bad, repl = self._clean_rows(step, batch)
+            if not repl:
+                continue
+            for k, a in arrays.items():
+                if a.ndim < (2 if stacked else 1):
+                    continue
+                b = np.array(np.asarray(out[k]), copy=True)
+                for r, src in repl.items():
+                    if stacked:
+                        b[si, r] = b[si, src]
+                    else:
+                        b[r] = b[src]
+                out[k] = b
+            replaced += len(repl)
+        if replaced and _obs.enabled():
+            _obs.metrics.counter('feed.quarantined_rows').inc(replaced)
+        return out, replaced
+
+    def wrap(self, feeds, start_step=0):
+        """Wrap a per-step feed iterable: each yielded dict has its
+        quarantined rows replaced (step ids count up from start_step).
+        Compose under a FeedPrefetcher so quarantine applies before
+        superbatch packing."""
+        def gen():
+            for i, f in enumerate(feeds):
+                yield self.apply(f, int(start_step) + i, 1)[0]
+        return gen()

@@ -117,6 +117,8 @@ SCHEMA = {
         ('host_blocked_s', ('sec', 'executor.host_blocked_s')),
         ('nan_poll_lag_steps', ('int', 'nan_poll.lag_steps')),
         ('prefetch_upload_overlap_s', ('sec', 'prefetch.upload_overlap_s')),
+        ('forensics_replays', ('int', 'recovery.forensics_replay_steps')),
+        ('quarantined_samples', ('int', 'feed.quarantined')),
     ),
     'serving': (
         ('admitted', ('int', 'serving.admitted')),
@@ -151,7 +153,10 @@ SCHEMA = {
             'executor.retraces', 'executor.stall_count',
             'prefetch.starvation_count', 'kernel.fallbacks',
             'nan_poll.polls', 'nan_poll.trips',
-            'executor.host_blocked_s'))),
+            'executor.host_blocked_s', 'recovery.forensics_runs',
+            'recovery.forensics_replay_steps',
+            'recovery.escalation.quarantine', 'recovery.escalation.skip',
+            'feed.quarantined', 'retry.attempts.feed_read'))),
     ),
 }
 

@@ -50,7 +50,12 @@ def _run_sub_op(ctx, sub, env, amp):
 @register('fused_elementwise')
 def fused_elementwise(ctx, ins, attrs):
     from . import kernelgen as _kg
-    if _kg.enabled():
+    fx = getattr(ctx, 'forensic', None)
+    if _kg.enabled() and fx is None:
+        # a forensic lowering never hands the group to kernelgen: the
+        # whole point is probing INSIDE the fused sub-program, which a
+        # single generated kernel hides.  Production launches keep the
+        # kernel tier — only the replay runner pays the granularity tax.
         try:
             return _kg.run_fused(ctx, ins, attrs)
         except Exception as e:        # noqa: BLE001 — loud by contract
@@ -59,6 +64,19 @@ def fused_elementwise(ctx, ins, attrs):
     xs = xs if isinstance(xs, (list, tuple)) else [xs]
     env = dict(zip(attrs['arg_names'], xs))
     amp = bool(getattr(ctx, 'amp', False))
+    pos = getattr(ctx, 'op_index', 0)
+    loc = getattr(getattr(ctx, 'op', None), 'source_loc', None)
     for sub in attrs['sub_ops']:
         _run_sub_op(ctx, sub, env, amp)
+        if fx is not None:
+            # sub-program granularity: each replayed sub-op's outputs
+            # get their own probe, named against the FUSED op's position
+            # (the probe writes into fx.env — the executor's outer env —
+            # so it escapes this impl's local sub-environment)
+            sloc = sub['attrs'].get('source_loc') or loc
+            for names in sub['outputs'].values():
+                for nm in names:
+                    if nm in env:
+                        fx.note(pos, 'fused:%s' % sub['type'], nm, sloc,
+                                env[nm])
     return {'Out': [env[n] for n in attrs['out_names']]}

@@ -36,9 +36,16 @@ Instrumented sites (kept in sync with docs/robustness.md):
   ``io_write``     io.save_vars tensor write raises OSError
   ``nan_step``     one training step's float feeds are overwritten with
                    NaN — loss and gradients blow up and the executor's
-                   fused check_nan verdict trips (core/executor.py)
+                   fused check_nan verdict trips (core/executor.py).
+                   ``row=R`` restricts the poison to batch row R so
+                   forensic row bisection has a ground truth to find
   ``prefetch_stall``  the FeedPrefetcher worker sleeps ``s`` seconds
                    before packing a superbatch (data_feeder.py)
+  ``feed_read``    one reader pull inside the FeedPrefetcher worker
+                   raises OSError INSIDE the retried callable — a
+                   transient reader blip that ``retry_with_backoff``
+                   must absorb instead of killing the trainer
+                   (data_feeder.py)
   ``sigterm``      the process sends itself SIGTERM after step N
                    completes (core/executor.py) — preemption rehearsal
   ``serve_dispatch``  the serving engine's batch dispatch raises —
@@ -82,10 +89,10 @@ from .. import observability as _obs
 
 __all__ = ['configure', 'reset', 'any_active', 'active', 'fire', 'fire_in',
            'maybe_fail', 'maybe_sleep', 'maybe_kill', 'poison_nan',
-           'InjectedFault', 'SITES']
+           'forensic_replay', 'spec', 'InjectedFault', 'SITES']
 
 SITES = ('ckpt_write', 'ckpt_io', 'cache_read', 'cache_write', 'io_read',
-         'io_write', 'nan_step', 'prefetch_stall', 'sigterm',
+         'io_write', 'nan_step', 'prefetch_stall', 'feed_read', 'sigterm',
          'serve_dispatch', 'serve_slow_batch', 'queue_overflow',
          'compile_storm', 'decode_step', 'device_loss', 'host_desync')
 
@@ -97,19 +104,21 @@ class InjectedFault(OSError):
 
 
 class _Fault(object):
-    __slots__ = ('site', 'at', 'times', 'sleep_s', 'hits', 'fired')
+    __slots__ = ('site', 'at', 'times', 'sleep_s', 'row', 'hits', 'fired')
 
-    def __init__(self, site, at=1, times=1, s=0.05):
+    def __init__(self, site, at=1, times=1, s=0.05, row=None):
         self.site = site
         self.at = int(at)
         self.times = max(1, int(times))
         self.sleep_s = float(s)
+        self.row = None if row is None else int(row)
         self.hits = 0       # invocation counter for hit-indexed sites
         self.fired = 0
 
 
 _ACTIVE = {}
 _CONFIGURED = [False]
+_REPLAY = [0]          # >0: forensic replay — nan_step ignores its budget
 _LOCK = threading.Lock()
 
 
@@ -129,10 +138,10 @@ def configure(text=None):
             for f in fields[1:]:
                 k, _, v = f.partition('=')
                 k = k.strip()
-                if k not in ('at', 'times', 's'):
+                if k not in ('at', 'times', 's', 'row'):
                     raise ValueError(
                         'PT_FAULT field %r for site %r not understood '
-                        '(known: at=N, times=K, s=SEC)' % (k, site))
+                        '(known: at=N, times=K, s=SEC, row=R)' % (k, site))
                 kw[k] = float(v) if k == 's' else int(v)
             _ACTIVE[site] = _Fault(site, **kw)
         _CONFIGURED[0] = True
@@ -163,10 +172,25 @@ def active(site):
     return site in _ACTIVE
 
 
+def spec(site):
+    """The armed _Fault for a site (None if disarmed).  Read-only use:
+    tests and soak harnesses compare a forensic verdict against the
+    injected ground truth (``spec('nan_step').at`` / ``.row``)."""
+    _ensure()
+    return _ACTIVE.get(site)
+
+
 def _count(site):
     _obs.metrics.counter('faults.injected').inc()
     _obs.metrics.counter('faults.injected.%s' % site).inc()
     _obs.tracing.instant('fault.injected', cat='fault', args={'site': site})
+
+
+def _replaying(site):
+    # forensic replay re-runs already-fired steps to localize the poison:
+    # nan_step must reproduce the original NaNs without consuming (or
+    # being blocked by) the spent budget
+    return _REPLAY[0] > 0 and site == 'nan_step'
 
 
 def fire(site, step=None):
@@ -179,7 +203,8 @@ def fire(site, step=None):
     if spec is None:
         return False
     with _LOCK:
-        if spec.fired >= spec.times:
+        replay = _replaying(site)
+        if spec.fired >= spec.times and not replay:
             # budget spent: a rollback that rewinds the caller's step
             # counter must not re-fire the same fault forever
             return False
@@ -189,8 +214,9 @@ def fire(site, step=None):
         else:
             idx = int(step)
         if spec.at <= idx < spec.at + spec.times:
-            spec.fired += 1
-            _count(site)
+            if not replay:
+                spec.fired += 1
+                _count(site)
             return True
     return False
 
@@ -203,14 +229,34 @@ def fire_in(site, start, count):
     if spec is None:
         return False
     with _LOCK:
-        if spec.fired >= spec.times:
+        replay = _replaying(site)
+        if spec.fired >= spec.times and not replay:
             return False
         lo, hi = spec.at, spec.at + spec.times
         if int(start) < hi and int(start) + int(count) > lo:
-            spec.fired += 1
-            _count(site)
+            if not replay:
+                spec.fired += 1
+                _count(site)
             return True
     return False
+
+
+class forensic_replay(object):
+    """Context manager: while active, the ``nan_step`` site replays its
+    armed window deterministically — firing decisions ignore the spent
+    budget and do not consume it, so a forensic re-run of step N poisons
+    exactly the feeds the original run poisoned, while post-forensics
+    production steps keep the one-shot budget semantics."""
+
+    def __enter__(self):
+        with _LOCK:
+            _REPLAY[0] += 1
+        return self
+
+    def __exit__(self, *exc):
+        with _LOCK:
+            _REPLAY[0] = max(0, _REPLAY[0] - 1)
+        return False
 
 
 def maybe_fail(site, step=None, exc=None):
@@ -250,21 +296,43 @@ def maybe_kill(site='sigterm', step=None, count=1, sig=signal.SIGTERM):
 
 def poison_nan(feed_vals, step, count=1):
     """``nan_step`` site: when the launch's step window [step, step+count)
-    covers the armed step, every float feed array is replaced with NaN —
-    the loss and every gradient blow up, and the executor's fused
-    check_nan verdict trips exactly as it would for a real numeric
-    divergence.  Shapes/dtypes are preserved so the poisoned launch
-    reuses the same executable (no retrace)."""
+    covers the armed step, the float feeds of exactly the armed steps are
+    overwritten with NaN — the loss and every gradient blow up, and the
+    executor's fused check_nan verdict trips exactly as it would for a
+    real numeric divergence.  With ``row=R`` only batch row R of each
+    armed step is poisoned (the batch axis is axis 0 of a per-step feed,
+    axis 1 of a ``count>1`` stacked launch), giving forensic row
+    bisection an exact ground truth.  Shapes/dtypes are preserved so the
+    poisoned launch reuses the same executable (no retrace)."""
     if not active('nan_step') or not fire_in('nan_step', step, count):
         return feed_vals
     import numpy as np
+    sp = spec('nan_step')
+    row = sp.row
+    # armed step ids intersected with this launch's [step, step+count)
+    lo = max(int(step), sp.at)
+    hi = min(int(step) + int(count), sp.at + sp.times)
     out = {}
     for k, v in feed_vals.items():
         a = np.asarray(v)
-        if np.issubdtype(a.dtype, np.floating):
-            out[k] = np.full(a.shape, np.nan, a.dtype)
-        else:
+        if not np.issubdtype(a.dtype, np.floating):
             out[k] = v
+            continue
+        b = np.array(a, copy=True)
+        if int(count) > 1:
+            # stacked launch: leading axis is the step axis
+            for s in range(lo, hi):
+                i = s - int(step)
+                if row is not None and b.ndim >= 2 and 0 <= row < b.shape[1]:
+                    b[i, row] = np.nan
+                else:
+                    b[i] = np.nan
+        else:
+            if row is not None and b.ndim >= 1 and 0 <= row < b.shape[0]:
+                b[row] = np.nan
+            else:
+                b[...] = np.nan
+        out[k] = b
     return out
 
 

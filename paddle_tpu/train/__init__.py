@@ -1,7 +1,10 @@
 from . import checkpoint
+from . import forensics
 from . import recovery
 from .checkpoint import CheckpointConfig, Checkpointer  # noqa: F401
+from .forensics import ForensicReport, LaunchRecord  # noqa: F401
 from .recovery import RecoveryPolicy, DivergenceError  # noqa: F401
 
-__all__ = ['checkpoint', 'recovery', 'CheckpointConfig', 'Checkpointer',
+__all__ = ['checkpoint', 'forensics', 'recovery', 'CheckpointConfig',
+           'Checkpointer', 'ForensicReport', 'LaunchRecord',
            'RecoveryPolicy', 'DivergenceError']

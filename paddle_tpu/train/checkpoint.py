@@ -200,13 +200,19 @@ class CheckpointConfig(object):
 class Checkpointer(object):
     """Periodic async checkpoint writer + newest-valid-checkpoint restorer."""
 
-    def __init__(self, config, executor, main_program=None, scope=None):
+    def __init__(self, config, executor, main_program=None, scope=None,
+                 quarantine=None):
         if isinstance(config, str):
             config = CheckpointConfig(config)
         self.config = config
         self.executor = executor
         self.main_program = main_program
         self.scope = scope
+        # optional data_feeder.SampleQuarantine: its sample-index set
+        # rides checkpoint META, so a resumed run never re-trips on a
+        # sample forensics already condemned (RecoveryPolicy discovers
+        # the quarantine through this attribute)
+        self.quarantine = quarantine
         self._serial = -1
         self._q = queue.Queue()
         self._pending = 0
@@ -370,6 +376,8 @@ class Checkpointer(object):
             meta['rng_state'] = rng()
         if extra_meta:
             meta.update(extra_meta)
+        if self.quarantine is not None:
+            meta['quarantine'] = self.quarantine.state()
         if cfg.sharded:
             # step-derived serials: lockstep hosts converge on the same
             # dir with no communication, and stay monotonic across a
@@ -806,6 +814,11 @@ class Checkpointer(object):
             if rng and callable(getattr(self.executor, 'set_rng_state',
                                         None)):
                 self.executor.set_rng_state(rng)
+            q = meta.get('quarantine')
+            if q and self.quarantine is not None:
+                # union, never shrink: indices condemned after this
+                # checkpoint was written stay condemned on rollback
+                self.quarantine.restore(q)
             self._serial = s
             if _obs.enabled():
                 _obs.metrics.counter('ckpt.restores').inc()

@@ -1,19 +1,28 @@
-"""Divergence guard: detect a blown-up step, roll back, skip, retry.
+"""Divergence guard: detect a blown-up step, explain it, heal, retry.
 
 A NaN/Inf step (the executor's fused ``check_nan`` verdict) or a loss
 spike (this module's heuristic) used to simply raise and kill the run —
 hours of soak lost to one bad superbatch.  `RecoveryPolicy` turns the
-raise into a bounded recovery loop:
+raise into a bounded recovery loop.  After every divergence the policy
+rolls back to the last good checkpoint (params + optimizer accumulators
++ RNG counters, via train/checkpoint.py), runs forensics on the
+condemned window when it can (train/forensics.py — the caller passed
+``launch=`` records), then climbs an **escalation ladder**, each rung
+metered under ``recovery.escalation.*``:
 
-  1. **rollback** — restore the last good checkpoint (params + optimizer
-     accumulators + RNG counters, via train/checkpoint.py), so the model
-     never trains on top of poisoned state;
-  2. **skip** — the offending superbatch is dropped (`run()` returns
+  1. **quarantine-sample** — forensics named poison batch rows: their
+     reader indices go into the data plane's quarantine
+     (data_feeder.SampleQuarantine, persisted in checkpoint META) and
+     the whole window is REPLAYED with those rows substituted — no data
+     is skipped, the run continues as if the poison never existed;
+  2. **skip-batch** — no named sample (or the quarantine replay
+     re-tripped): the offending superbatch is dropped (`run()` returns
      None and the caller moves to the next batch);
-  3. **dampen** — optionally scale a named LR variable down;
+  3. **LR-scale** — divergences keep coming (``lr_after`` consecutive):
+     scale the named LR variable down on rollback;
   4. **give up** — after ``max_retries`` consecutive divergences the
-     original exception re-raises: a systematically-diverging run should
-     die loudly, not loop forever.
+     original exception re-raises with a flight dump: a systematically-
+     diverging run should die loudly, not loop forever.
 
 Every action is counted in observability (``recovery.*`` — see
 docs/robustness.md) so a "healthy" run that silently rolled back 50
@@ -26,6 +35,10 @@ from ..observability import flight as _flight
 from ..observability import trace_context as _tc
 
 __all__ = ['DivergenceError', 'RecoveryPolicy', 'is_divergence']
+
+# sentinel: the quarantine-replay rung failed (distinct from a launch
+# legitimately returning None)
+_REPLAY_FAILED = object()
 
 
 class DivergenceError(RuntimeError):
@@ -55,7 +68,9 @@ class RecoveryPolicy(object):
     the next one"."""
 
     def __init__(self, checkpointer, max_retries=3, lr_var=None,
-                 lr_scale=None, spike_factor=None, window=32, min_history=5):
+                 lr_scale=None, spike_factor=None, window=32, min_history=5,
+                 quarantine=None, forensics=None, sample_index_of=None,
+                 lr_after=2, max_window_records=64):
         if checkpointer is None:
             raise ValueError('RecoveryPolicy needs a Checkpointer to roll '
                              'back to')
@@ -68,6 +83,24 @@ class RecoveryPolicy(object):
         self.min_history = max(2, int(min_history))
         self._history = []
         self._consecutive = 0
+        # ---- forensics / escalation-ladder state -------------------------
+        # the quarantine usually rides the Checkpointer (META persistence);
+        # an explicit one wins
+        self.quarantine = quarantine if quarantine is not None \
+            else getattr(checkpointer, 'quarantine', None)
+        if forensics is None:
+            from . import forensics as _forensics
+            forensics = _forensics.enabled()
+        self.forensics = bool(forensics)
+        self.sample_index_of = sample_index_of
+        # LR-scale rung: dampen only from the lr_after-th CONSECUTIVE
+        # divergence on — the first trip gets quarantine/skip a chance
+        # to heal at full speed
+        self.lr_after = max(1, int(lr_after))
+        self.max_window_records = max(1, int(max_window_records))
+        self._window_records = []   # LaunchRecords since the last checkpoint
+        self.last_report = None     # most recent ForensicReport
+        self.last_replay = None     # [(step0, steps, out)] from rung 1
 
     # ------------------------------------------------------------ heuristic
     def check_loss(self, loss):
@@ -92,11 +125,35 @@ class RecoveryPolicy(object):
             self._history.pop(0)
 
     # -------------------------------------------------------------- driver
-    def run(self, fn, loss_index=0):
+    def note_checkpoint(self, step_id):
+        """Tell the policy a checkpoint covering steps <= ``step_id``
+        landed: buffered launch records at or before it can never be
+        condemned again and are dropped.  Callers that pass ``launch=``
+        should call this after every ``save``/``maybe_save`` hit so the
+        forensic window stays aligned with the restore point."""
+        s = int(step_id)
+        self._window_records = [r for r in self._window_records
+                                if r.step0 + r.nsteps - 1 > s]
+
+    def run(self, fn, loss_index=0, launch=None):
         """Run one launch.  Returns its fetches, or None when the launch
-        diverged and was rolled back (the caller skips the superbatch).
-        Re-raises after ``max_retries`` consecutive divergences, and
-        re-raises immediately for non-divergence errors."""
+        diverged and was rolled back AND skipped (the caller drops the
+        whole in-flight window and moves to the next batch).  When the
+        quarantine rung heals the window instead, the CURRENT launch's
+        fetches are returned and ``last_replay`` holds every replayed
+        launch's output.  Re-raises after ``max_retries`` consecutive
+        divergences, and immediately for non-divergence errors.
+
+        ``launch`` (a forensics.LaunchRecord) opts this launch into the
+        forensic window: without records the policy degrades to plain
+        rollback-and-skip."""
+        self.last_replay = None
+        if launch is not None:
+            self._window_records.append(launch)
+            if len(self._window_records) > self.max_window_records:
+                # bounded buffer: an over-long window aborts forensics
+                # (window_gap) rather than replaying from a wrong base
+                self._window_records.pop(0)
         try:
             out = fn()
             if out and loss_index is not None and self.spike_factor:
@@ -131,14 +188,108 @@ class RecoveryPolicy(object):
                                window_steps=window)
             if self._consecutive > self.max_retries:
                 _obs.metrics.counter('recovery.giveups').inc()
+                _obs.metrics.counter('recovery.escalation.giveup').inc()
                 _flight.record('recovery.giveup', error=repr(e)[:300],
                                consecutive=self._consecutive)
                 # the re-raise kills the run; leave the postmortem behind
                 _flight.maybe_dump('recovery_giveup')
                 raise
-            self.rollback(reason=repr(e)[:200])
+            meta = self.rollback(reason=repr(e)[:200])
+            report = self._investigate(meta)
+            # ---- rung 1: quarantine-sample + heal the window ----------
+            if report is not None and report.sample_indices and \
+                    self.quarantine is not None and self._consecutive == 1:
+                self.quarantine.add(report.sample_indices,
+                                    reason='forensics step %s'
+                                    % report.step)
+                out = self._replay_window()
+                if out is not _REPLAY_FAILED:
+                    _obs.metrics.counter(
+                        'recovery.escalation.quarantine').inc()
+                    _obs.tracing.instant(
+                        'recovery.quarantine_heal', cat='recovery',
+                        args={'samples': report.sample_indices,
+                              'step': report.step})
+                    self._consecutive = 0
+                    return out
+                # the replay re-tripped with the rows substituted: the
+                # verdict was wrong or incomplete — roll back again and
+                # fall through to skip-batch
+                _obs.metrics.counter(
+                    'recovery.escalation.quarantine_failed').inc()
+                self.rollback(reason='quarantine replay re-tripped')
+            # ---- rung 2: skip-batch -----------------------------------
+            _obs.metrics.counter('recovery.escalation.skip').inc()
             _obs.metrics.counter('recovery.skipped_steps').inc()
+            # the caller drops the in-flight window on None: those
+            # launches will never be replayed, so their records are dead
+            self._window_records = []
             return None
+
+    def _investigate(self, meta):
+        """Run forensics over the buffered window (best-effort: a
+        forensics bug must never turn a recoverable divergence into a
+        crash)."""
+        if not self.forensics or not self._window_records or meta is None:
+            return None
+        from . import forensics as _forensics
+        try:
+            report = _forensics.investigate(
+                self.checkpointer, list(self._window_records), meta=meta,
+                sample_index_of=self.sample_index_of)
+        except Exception as fe:   # noqa: BLE001 - forensics is best-effort
+            _obs.metrics.counter('recovery.forensics_errors').inc()
+            _flight.record('forensics.error', error=repr(fe)[:300])
+            return None
+        if report is not None:
+            self.last_report = report
+        return report
+
+    def _replay_window(self):
+        """Rung 1's heal: re-run every buffered launch from the restored
+        checkpoint with quarantined rows substituted out of the feeds.
+        Returns the LAST launch's fetches (what the condemned call would
+        have returned) or _REPLAY_FAILED when the window re-trips."""
+        exe = getattr(self.checkpointer, 'executor', None)
+        if exe is None:
+            return _REPLAY_FAILED
+        scope = self.checkpointer.scope
+        self.last_replay = []
+        out = None
+        try:
+            # the replay is an intentional slow window (sync fetches,
+            # no prefetch): launch gaps inside it are not pipeline stalls
+            with _obs.stall.suppress('quarantine_replay'):
+                for rec in self._window_records:
+                    feed = rec.feed
+                    if self.quarantine is not None and len(self.quarantine):
+                        feed, _ = self.quarantine.apply(
+                            feed, rec.step0, rec.steps or 1)
+                    if rec.steps is None:
+                        out = exe.run(rec.program, feed=feed,
+                                      fetch_list=rec.fetch_list,
+                                      scope=scope)
+                    else:
+                        out = exe.run_steps(rec.program, feed_list=feed,
+                                            steps=rec.steps,
+                                            fetch_list=rec.fetch_list,
+                                            scope=scope)
+                    self.last_replay.append((rec.step0, rec.nsteps, out))
+                # the launches above pushed fresh verdicts; force the poll
+                # NOW so a still-poisoned window fails HERE, not at a
+                # later poll that would condemn innocent steps
+                if hasattr(exe, 'poll_nan'):
+                    exe.poll_nan()
+        except Exception as e:   # noqa: BLE001 - classified right below
+            if not is_divergence(e):
+                raise
+            self.last_replay = None
+            return _REPLAY_FAILED
+        finally:
+            # the next production launch must not be measured against the
+            # replay's timeline
+            _obs.stall.clear_window(exe)
+        return out
 
     def rollback(self, reason=''):
         """Restore the last good checkpoint into the scope (+ RNG/run
@@ -157,13 +308,18 @@ class RecoveryPolicy(object):
             _obs.tracing.instant('recovery.rollback', cat='recovery',
                                  args={'to_step': meta.get('step_id'),
                                        'reason': reason})
-            if self.lr_var and self.lr_scale:
+            if self.lr_var and self.lr_scale and \
+                    self._consecutive >= self.lr_after:
+                # rung 3: quarantine/skip didn't stop the bleeding — the
+                # divergence is systemic, not one bad sample.  Dampen.
                 scope = self.checkpointer._scope()
                 if self.lr_var in scope:
                     lr = np.asarray(scope.get(self.lr_var))
                     scope.set(self.lr_var,
                               (lr * self.lr_scale).astype(lr.dtype))
                     _obs.metrics.counter('recovery.lr_scaled').inc()
+                    _obs.metrics.counter(
+                        'recovery.escalation.lr_scale').inc()
         # drop any verdicts still accumulating on device: they were
         # computed over the poisoned (pre-restore) stream and would trip
         # a later poll against the clean restored state

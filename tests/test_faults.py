@@ -20,12 +20,16 @@ def _clean_faults():
 # ------------------------------------------------------------------ parsing
 
 def test_spec_parsing():
-    armed = faults.configure('ckpt_write:at=2,nan_step:at=5:times=3,'
+    armed = faults.configure('ckpt_write:at=2,nan_step:at=5:times=3:row=1,'
                              'prefetch_stall:at=1:s=0.25')
     assert set(armed) == {'ckpt_write', 'nan_step', 'prefetch_stall'}
     assert armed['nan_step'].at == 5 and armed['nan_step'].times == 3
+    assert armed['nan_step'].row == 1
     assert armed['prefetch_stall'].sleep_s == 0.25
     assert faults.active('ckpt_write') and not faults.active('cache_read')
+    # spec() is the read-only accessor soak gates compare verdicts against
+    assert faults.spec('nan_step').row == 1
+    assert faults.spec('cache_read') is None
 
 
 def test_spec_rejects_unknown_field():
@@ -63,6 +67,30 @@ def test_fire_in_window_overlap():
     assert not faults.fire_in('sigterm', 0, 4)    # [0,4) misses 5
     assert faults.fire_in('sigterm', 4, 4)        # [4,8) covers 5
     assert not faults.fire_in('sigterm', 4, 4)    # budget spent
+
+
+def test_forensic_replay_ignores_and_preserves_spent_budget():
+    """Inside forensic_replay() the nan_step site re-fires its armed
+    window without consuming budget; outside, the one-shot semantics
+    are intact — before AND after the replay."""
+    before = obs.counters().get('faults.injected.nan_step') or 0
+    faults.configure('nan_step:at=4')
+    assert faults.fire_in('nan_step', 4, 2)       # production: consumed
+    assert not faults.fire_in('nan_step', 4, 2)   # budget spent
+    with faults.forensic_replay():
+        assert faults.fire_in('nan_step', 4, 2)   # replay re-fires...
+        assert faults.fire('nan_step', step=4)    # ...as often as asked
+    assert not faults.fire_in('nan_step', 4, 2)   # budget still spent
+    # the replay fires were not re-counted as injections
+    assert obs.counters().get('faults.injected.nan_step') == before + 1
+
+
+def test_forensic_replay_only_covers_nan_step():
+    faults.configure('cache_read:at=1')
+    assert faults.fire('cache_read')
+    with faults.forensic_replay():
+        # other sites keep their budget semantics during a replay
+        assert not faults.fire('cache_read')
 
 
 def test_fired_faults_count_into_observability():
@@ -183,6 +211,84 @@ def test_prefetch_stall_site_fires_and_counts():
     pf.close()
     assert got == [2, 2]
     assert obs.counters().get('faults.injected.prefetch_stall') == before + 1
+
+
+# ------------------------------------------------------------ feed_read site
+
+def test_feed_read_fault_absorbed_by_retry():
+    """One injected reader OSError must NOT kill the trainer: the worker
+    pulls through retry_with_backoff, which absorbs it and re-reads."""
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    before = obs.counters().get('retry.attempts.feed_read') or 0
+    faults.configure('feed_read:at=2')
+    feeds = [{'x': np.full((2, 2), i, np.float32)} for i in range(4)]
+    pf = FeedPrefetcher(iter(feeds), steps=2, to_device=False)
+    got = [(f, k) for f, k in pf]
+    pf.close()
+    assert [k for _, k in got] == [2, 2]
+    # retried, not reordered: every batch arrived exactly once, in order
+    vals = [float(f['x'][j, 0, 0]) for f, _ in got for j in range(2)]
+    assert vals == [0.0, 1.0, 2.0, 3.0]
+    assert (obs.counters().get('retry.attempts.feed_read') or 0) >= before + 1
+
+
+def test_feed_read_exhaustion_is_not_a_retry():
+    """Reader exhaustion (StopIteration) must drain cleanly through the
+    retry wrapper — no attempts, no giveups: an empty stream is not a
+    fault."""
+    from paddle_tpu.data_feeder import FeedPrefetcher
+    faults.configure('feed_read:at=99')   # armed but never reached
+    c0 = obs.counters()
+    feeds = [{'x': np.zeros((2, 2), np.float32)} for _ in range(3)]
+    pf = FeedPrefetcher(iter(feeds), steps=2, to_device=False)
+    got = [k for _, k in pf]
+    pf.close()
+    assert got == [2, 1]                  # partial tail flushed
+    c = obs.counters()
+    for key in ('retry.attempts.feed_read', 'retry.giveups.feed_read'):
+        assert (c.get(key) or 0) == (c0.get(key) or 0)
+
+
+# --------------------------------------------------- poison_nan row targeting
+
+def test_poison_nan_row_targets_single_row():
+    faults.configure('nan_step:at=0:row=1')
+    feed = {'x': np.ones((4, 3), np.float32),
+            'lbl': np.zeros((4, 1), np.int64)}
+    out = faults.poison_nan(feed, 0, 1)
+    x = out['x']
+    assert np.isnan(x[1]).all()                       # armed row poisoned
+    assert np.isfinite(np.delete(x, 1, axis=0)).all()  # others untouched
+    np.testing.assert_array_equal(out['lbl'], feed['lbl'])  # ints skipped
+    assert np.isfinite(feed['x']).all()               # input not mutated
+
+
+def test_poison_nan_row_in_stacked_launch():
+    """count>1 launches stack steps on axis 0, so the batch is axis 1:
+    only (armed step, armed row) goes NaN."""
+    faults.configure('nan_step:at=2:row=1')
+    feed = {'x': np.ones((4, 3, 2), np.float32)}      # [K=4 steps, B=3, 2]
+    out = faults.poison_nan(feed, 0, 4)
+    x = out['x']
+    assert np.isnan(x[2, 1]).all()
+    mask = np.ones(x.shape, bool)
+    mask[2, 1] = False
+    assert np.isfinite(x[mask]).all()
+
+
+def test_poison_nan_without_row_poisons_whole_step():
+    faults.configure('nan_step:at=1')
+    feed = {'x': np.ones((3, 2, 2), np.float32)}      # [K=3 steps, B=2, 2]
+    out = faults.poison_nan(feed, 0, 3)
+    x = out['x']
+    assert np.isnan(x[1]).all()                       # entire armed step
+    assert np.isfinite(x[0]).all() and np.isfinite(x[2]).all()
+
+
+def test_poison_nan_outside_window_is_identity():
+    faults.configure('nan_step:at=7:row=0')
+    feed = {'x': np.ones((2, 2), np.float32)}
+    assert faults.poison_nan(feed, 0, 2) is feed      # window miss: no copy
 
 
 # ------------------------------------------------------------ executor site

@@ -1,0 +1,159 @@
+"""Forensic probe lowering (train/forensics.py + the executor's
+ForensicProbes collector): per-op finite probes, fused sub-op
+granularity, row-bisection helpers, and investigation guard rails.
+The end-to-end trip->report->quarantine->heal path lives in
+test_resilience.py; these are the unit seams under it."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.core import passes
+from paddle_tpu.testing import faults
+from paddle_tpu.train import forensics
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _probe_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[4], dtype='float32')
+            h = fluid.layers.fc(x, 3, act='relu')
+            out = fluid.layers.reduce_mean(h)
+    return main, startup, out
+
+
+# ----------------------------------------------------------- probe lowering
+
+def test_probes_flag_first_bad_op_with_source_loc():
+    main, startup, out = _probe_program()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        runner = forensics._Runner(exe, main, ('x',), (out.name,))
+        ok, probes, _ = runner.step(
+            scope, {'x': np.ones((2, 4), 'float32')}, 0)
+        meta = runner.collector.meta
+        assert meta, 'no probes collected'
+        # one [all_finite, nonfinite_count, max_abs] row per probed op
+        assert ok and probes.shape == (len(meta), 3)
+        assert (probes[:, 0] > 0.5).all()
+        # a poisoned feed flips the verdict, and the FIRST false probe is
+        # the op that consumed x — same position the analyzer stamped
+        block = main.global_block()
+        want = next(op for op in block.ops
+                    if any('x' in (op.inputs.get(k) or [])
+                           for k in op.inputs))
+        ok, probes, _ = runner.step(
+            scope, {'x': np.full((2, 4), np.nan, 'float32')}, 0)
+        assert not ok
+        first = min(j for j in range(probes.shape[0])
+                    if probes[j, 0] < 0.5)
+        m = meta[first]
+        assert m['op_type'] == want.type
+        assert m['source_loc'], 'probe must carry the op source_loc'
+        assert probes[first, 1] > 0          # nonfinite element count
+
+
+def test_fused_groups_probe_at_sub_op_granularity():
+    """The production executor fuses elementwise chains into one op; the
+    forensic lowering must still see INSIDE the group — one probe per
+    sub-op, named fused:<type>."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        h = fluid.layers.scale(x, scale=2.0, bias=1.0)
+        h = fluid.layers.relu(h)
+        out = fluid.layers.scale(h, scale=0.5)
+    opt, _stats = passes.optimize_program(main, (out.name,))
+    assert [op.type for op in opt.global_block().ops] == \
+        ['fused_elementwise']
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        runner = forensics._Runner(exe, opt, ('x',), (out.name,))
+        ok, probes, _ = runner.step(
+            scope, {'x': np.ones((2, 4), 'float32')}, 0)
+        types = [m['op_type'] for m in runner.collector.meta]
+        assert 'fused:scale' in types and 'fused:relu' in types
+        assert ok and probes.shape[0] == len(types)
+
+
+# ------------------------------------------------------------- row phase
+
+def test_delta_rows_finds_culprits_in_both_halves():
+    culprits = {1, 6}
+
+    def clean_without(rows_out):
+        return culprits <= set(rows_out)
+
+    got = forensics._delta_rows(list(range(8)), [], clean_without)
+    assert sorted(got) == [1, 6]
+
+
+def test_overflow_row_named_by_substitution_bisection():
+    """A row that is FINITE in the feed but overflows inside the step
+    (so the feed_scan fast path finds nothing) must still be named, via
+    zero-substitution bisection."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        out = fluid.layers.reduce_mean(fluid.layers.square(x))
+    feed = np.ones((4, 4), 'float32')
+    feed[2] = 1e30                  # finite in the feed, inf after square
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        runner = forensics._Runner(exe, main, ('x',), (out.name,))
+        report = forensics.ForensicReport()
+        forensics._bisect(runner, scope, [(5, {'x': feed})], 5, report,
+                          None, 24)
+    assert report.tripped and report.step == 5
+    assert report.op_type == 'square'
+    assert report.rows == [2] and report.row_method == 'substitution'
+    assert report.sample_indices == [5 * 4 + 2]   # step*batch + row
+    assert report.probe_launches >= 2
+
+
+def test_state_borne_poison_yields_state_verdict():
+    """When the carried state (a param) is already poisoned, even a fully
+    zeroed batch trips — forensics must say 'state', not invent rows."""
+    main, startup, out = _probe_program()
+    exe, scope = fluid.Executor(), fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = np.array(np.asarray(scope.get('fc_0.w_0')), copy=True)
+        w[0, 0] = np.nan
+        scope.set('fc_0.w_0', w)
+        runner = forensics._Runner(exe, main, ('x',), (out.name,))
+        report = forensics.ForensicReport()
+        forensics._bisect(runner, scope,
+                          [(0, {'x': np.ones((2, 4), 'float32')})],
+                          0, report, None, 8)
+    assert report.tripped and report.step == 0
+    assert report.rows is None and report.row_method == 'state'
+
+
+# ------------------------------------------------------------ guard rails
+
+def test_investigate_aborts_on_missing_meta_and_window_gap():
+    main, startup, out = _probe_program()
+    exe = fluid.Executor()
+    ck = type('Ck', (), {})()
+    ck.executor = exe
+    rec = forensics.LaunchRecord(main, {'x': np.ones((2, 4), 'float32')},
+                                 None, [out], 7)
+    a0 = obs.counters().get('recovery.forensics_aborted') or 0
+    # no restored META: nothing to align the replay window against
+    assert forensics.investigate(ck, [rec], meta=None) is None
+    # a gap between the checkpoint step and the buffered window would
+    # mis-align RNG streams — refuse rather than replay garbage
+    assert forensics.investigate(ck, [rec], meta={'step_id': 3}) is None
+    assert obs.counters().get('recovery.forensics_aborted') == a0 + 2

@@ -12,8 +12,9 @@ import pytest
 
 import paddle_tpu as fluid
 import paddle_tpu.observability as obs
+from paddle_tpu.data_feeder import SampleQuarantine
 from paddle_tpu.testing import faults
-from paddle_tpu.train import (CheckpointConfig, Checkpointer,
+from paddle_tpu.train import (CheckpointConfig, Checkpointer, LaunchRecord,
                               RecoveryPolicy, DivergenceError)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -483,6 +484,271 @@ def test_sigkill_and_auto_resume_is_bitwise(tmp_path, mode):
     # bitwise: the resumed tail equals the uninterrupted run's tail
     assert res['losses'] == ref['losses'][res['start']:], \
         'resumed run diverged from the uninterrupted one'
+
+
+# ------------------------------------- forensics & sample quarantine (E2E)
+
+def _stack_feeds(i0, k):
+    per = [_feed_at(i0 + j) for j in range(k)]
+    return {n: np.stack([f[n] for f in per]) for n in per[0]}
+
+
+def _forensic_reference(qstate, total, k=1):
+    """Uninjected run with the quarantine pre-seeded — the bitwise target
+    a healed run must match.  Launch shape (single-step vs run_steps
+    windows) mirrors the injected run so RNG stream counters line up."""
+    faults.configure('')   # disarm: this is the clean-world counterfactual
+    main, startup, loss = _build_model()
+    exe, scope = fluid.Executor(check_nan=True), fluid.Scope()
+    q = SampleQuarantine()
+    q.restore(qstate)
+    losses = {}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        step = 0
+        while step < total:
+            if k == 1:
+                feed, _ = q.apply(_feed_at(step), step)
+                out = exe.run(main, feed=feed, fetch_list=[loss])
+                losses[step] = float(np.asarray(out[0]).ravel()[0])
+            else:
+                stacked, _ = q.apply(_stack_feeds(step, k), step, k)
+                out = exe.run_steps(main, feed_list=stacked, steps=k,
+                                    fetch_list=[loss])
+                for j, v in enumerate(np.asarray(out[0]).ravel()):
+                    losses[step + j] = float(v)
+            step += k
+    return losses
+
+
+def test_forensics_names_injected_op_and_row_sync(tmp_path):
+    """The tentpole contract, sync verdicts (nan_poll=1): a row-targeted
+    nan_step trip must come back as a ForensicReport naming the exact
+    step, consuming op, and batch row; the row's sample lands in the
+    quarantine; the healed loss stream is BITWISE equal to an uninjected
+    run with the same quarantine pre-seeded."""
+    faults.configure('nan_step:at=2:row=1')
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(check_nan=True, nan_poll=1)
+    scope = fluid.Scope()
+    q = SampleQuarantine()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1,
+                                       max_num_checkpoints=3),
+                      exe, main, scope=scope, quarantine=q)
+    pol = RecoveryPolicy(ck, max_retries=4)
+    losses = {}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(0, -1)
+        ck.wait()
+        pol.note_checkpoint(-1)
+        for i in range(5):
+            out = pol.run(lambda: exe.run(main, feed=_feed_at(i),
+                                          fetch_list=[loss]),
+                          launch=LaunchRecord(main, _feed_at(i), None,
+                                              [loss], i))
+            if pol.last_replay is not None:       # rung 1 healed the window
+                for s0, _n, o in pol.last_replay:
+                    losses[s0] = float(np.asarray(o[0]).ravel()[0])
+            else:
+                assert out is not None, 'forensic heal must not skip-batch'
+                losses[i] = float(np.asarray(out[0]).ravel()[0])
+            ck.save(0, i)
+            ck.wait()
+            pol.note_checkpoint(i)
+    rep = pol.last_report
+    assert rep is not None and rep.tripped, 'no forensic report'
+    assert rep.step == 2 and rep.rows == [1]
+    assert rep.row_method == 'feed_scan'
+    assert rep.op_type and rep.source_loc, 'report must name the op'
+    assert 2 * 4 + 1 in q.state()      # default step*batch_size+row mapping
+    assert sorted(losses) == list(range(5))
+    assert all(np.isfinite(v) for v in losses.values())
+    assert (obs.counters().get('recovery.escalation.quarantine') or 0) >= 1
+    assert losses == _forensic_reference(q.state(), 5)
+
+
+def test_forensics_localizes_inside_deferred_window_async(tmp_path):
+    """Same contract under deferred verdicts (nan_poll=4, as_futures):
+    the trip lands steps AFTER the poisoned launch, so forensics must
+    bisect the whole condemned multi-launch window back to one step and
+    one row — and the heal must still be bitwise."""
+    faults.configure('nan_step:at=2:row=1')
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(check_nan=True, nan_poll=4)
+    scope = fluid.Scope()
+    q = SampleQuarantine()
+    ck = Checkpointer(CheckpointConfig(str(tmp_path), step_interval=1,
+                                       max_num_checkpoints=3),
+                      exe, main, scope=scope, quarantine=q)
+    pol = RecoveryPolicy(ck, max_retries=4)
+    K, total = 2, 8
+    losses = {}
+    pending = []   # [(loss_future, step0)] not yet past a clean poll
+
+    def flush():
+        for f, s0 in pending:
+            for j, v in enumerate(np.asarray(f).ravel()):
+                losses[s0 + j] = float(v)
+        del pending[:]
+
+    def land_replay():
+        del pending[:]   # condemned-launch futures: superseded by the heal
+        for s0, _n, o in pol.last_replay:
+            for j, v in enumerate(np.asarray(o[0]).ravel()):
+                losses[s0 + j] = float(v)
+
+    def saved(step_id):
+        ck.save(0, step_id)
+        ck.wait()
+        pol.note_checkpoint(step_id)
+
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ck.save(0, -1)
+        ck.wait()
+        pol.note_checkpoint(-1)
+        step = 0
+        while step < total:
+            stacked = _stack_feeds(step, K)
+            out = pol.run(
+                lambda: exe.run_steps(main, feed_list=stacked, steps=K,
+                                      fetch_list=[loss], as_futures=True),
+                launch=LaunchRecord(main, stacked, K, [loss], step))
+            if pol.last_replay is not None:
+                land_replay()
+                saved(step + K - 1)
+            elif out is not None:
+                pending.append((out[0], step))
+                if exe.nan_clean():   # deferred verdict read AND clean
+                    flush()
+                    saved(step + K - 1)
+            step += K
+        if pending:
+            def drain():
+                exe.poll_nan()
+                return []
+            tail = pol.run(drain)
+            if pol.last_replay is not None:
+                land_replay()
+            elif tail is not None:
+                flush()
+    rep = pol.last_report
+    assert rep is not None and rep.tripped, 'no forensic report'
+    assert rep.step == 2 and rep.rows == [1]
+    assert rep.op_type and rep.source_loc
+    assert 2 * 4 + 1 in q.state()
+    assert sorted(losses) == list(range(total))
+    assert all(np.isfinite(v) for v in losses.values())
+    assert losses == _forensic_reference(q.state(), total, k=K)
+
+
+_FORENSIC_SCRIPT = r"""
+import json, os, signal, sys
+os.environ['JAX_PLATFORMS'] = 'cpu'
+os.environ.setdefault('PT_CACHE', '0')
+sys.path.insert(0, sys.argv[1])
+ckpt_dir = sys.argv[2]
+total, kill_at = int(sys.argv[3]), int(sys.argv[4])
+import numpy as np
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.data_feeder import SampleQuarantine
+from paddle_tpu.train import (CheckpointConfig, Checkpointer, LaunchRecord,
+                              RecoveryPolicy)
+
+main, startup = fluid.Program(), fluid.Program()
+main.random_seed = 11
+with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard():
+        x = fluid.layers.data('x', shape=[4], dtype='float32')
+        lbl = fluid.layers.data('lbl', shape=[1], dtype='int64')
+        h = fluid.layers.fc(x, 8, act='relu')
+        logits = fluid.layers.fc(h, 3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+EPOCH, BATCH = 4, 4
+
+def feed_at(i):
+    e = i % EPOCH
+    rng = np.random.RandomState(100 + e)
+    f = {'x': rng.rand(BATCH, 4).astype('float32'),
+         'lbl': rng.randint(0, 3, (BATCH, 1)).astype('int64')}
+    if e == 1:
+        f['x'][2] = np.nan   # a genuinely bad sample, recurs every epoch
+    return f
+
+def index_of(step, row, batch):
+    # epoch-stable reader index: the same bad sample keeps the same id
+    return (int(step) % EPOCH) * batch + int(row)
+
+exe = fluid.Executor(check_nan=True, nan_poll=1)
+scope = fluid.Scope()
+q = SampleQuarantine(index_of=index_of)
+ck = Checkpointer(CheckpointConfig(ckpt_dir, step_interval=1,
+                                   max_num_checkpoints=3),
+                  exe, main, scope=scope, quarantine=q)
+pol = RecoveryPolicy(ck, max_retries=4, sample_index_of=index_of)
+meta = ck.restore()
+start = meta['step_id'] + 1 if meta else 0
+losses = []
+with fluid.scope_guard(scope):
+    if meta is None:
+        exe.run(startup)
+        ck.save(0, -1)
+        ck.wait()
+        pol.note_checkpoint(-1)
+    for i in range(start, total):
+        feed = q.apply(feed_at(i), i)[0]
+        out = pol.run(lambda: exe.run(main, feed=feed, fetch_list=[loss]),
+                      launch=LaunchRecord(main, feed, None, [loss], i))
+        if pol.last_replay is not None:
+            for s0, n, o in pol.last_replay:
+                losses.append(float(np.asarray(o[0]).ravel()[0]))
+        elif out is not None:
+            losses.append(float(np.asarray(out[0]).ravel()[0]))
+        ck.save(0, i)
+        ck.wait()
+        pol.note_checkpoint(i)
+        if i == kill_at:
+            os.kill(os.getpid(), signal.SIGKILL)
+print(json.dumps({'start': start, 'losses': losses,
+                  'divergences':
+                      obs.counters().get('recovery.divergences') or 0,
+                  'quarantine': q.state()}))
+"""
+
+
+def _run_forensic_proc(ckpt_dir, total=12, kill_at=-1, timeout=240):
+    env = {k: v for k, v in os.environ.items() if k != 'PT_FAULT'}
+    return subprocess.run(
+        [sys.executable, '-c', _FORENSIC_SCRIPT, REPO, str(ckpt_dir),
+         str(total), str(kill_at)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_sigkill_resume_restores_quarantine_from_meta(tmp_path):
+    """The satellite contract: a genuinely bad sample (NaN row baked into
+    the data, recurring every epoch) is quarantined by forensics in epoch
+    one; the process is then SIGKILLed.  The resumed process must inherit
+    the quarantine from checkpoint META and finish the run WITHOUT ever
+    re-tripping on that sample."""
+    killed = _run_forensic_proc(tmp_path / 'ck', total=12, kill_at=6)
+    assert killed.returncode == -signal.SIGKILL, (killed.returncode,
+                                                  killed.stderr)
+    resumed = _run_forensic_proc(tmp_path / 'ck', total=12)
+    assert resumed.returncode == 0, resumed.stderr
+    res = json.loads(resumed.stdout.strip().splitlines()[-1])
+    assert res['start'] == 7, res['start']
+    # (epoch step 1, row 2) on batch 4 -> stable reader index 6,
+    # restored from META — not re-derived by a second forensic run
+    assert res['quarantine'] == [6], res['quarantine']
+    assert res['divergences'] == 0, \
+        'resume re-tripped on an already-quarantined sample'
+    assert len(res['losses']) == 5
+    assert all(np.isfinite(res['losses']))
 
 
 def test_sigterm_flushes_final_checkpoint_and_resumes_bitwise(tmp_path):

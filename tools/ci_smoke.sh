@@ -334,6 +334,38 @@ if [ "$async_rc" -ne 0 ]; then
 fi
 rm -rf "$async_dir"
 
+echo "== ci_smoke: NaN forensics (bisection + quarantine heal, sync) =="
+# forensics gate (docs/robustness.md): a single poisoned batch row
+# (nan_step:at=5:row=3) trips the verdict; the forensic pipeline must
+# replay the condemned window, bisect to the EXACT (step, op, row),
+# quarantine the sample, HEAL the window by replaying it with the row
+# substituted, and finish with losses bitwise-identical to an
+# in-process uninjected reference run over the same quarantine —
+# --expect-forensics asserts every link of that chain.
+forensic_dir=$(mktemp -d /tmp/pt_forensic.XXXXXX)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 \
+    PT_FAULT="nan_step:at=5:row=3" \
+    python tools/fault_soak.py --steps 12 --ckpt "$forensic_dir/ckpt" \
+    --expect-forensics --assert-recovery
+forensic_rc=$?
+if [ "$forensic_rc" -ne 0 ]; then
+    echo "ci_smoke: forensics (sync) FAILED (rc=$forensic_rc)"
+fi
+
+echo "== ci_smoke: NaN forensics (deferred async window, PT_NAN_POLL=8) =="
+# the same gate with the deferred verdict: the trip only surfaces at an
+# 8-step poll boundary, so the forensic step walk must localize the
+# poison INSIDE the condemned window before the op/row bisection
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 PT_ASYNC=1 \
+    PT_NAN_POLL=8 PT_FAULT="nan_step:at=5:row=3" \
+    python tools/fault_soak.py --steps 16 --ckpt "$forensic_dir/ckpt2" \
+    --expect-forensics --expect-async
+forensic_async_rc=$?
+if [ "$forensic_async_rc" -ne 0 ]; then
+    echo "ci_smoke: forensics (async) FAILED (rc=$forensic_async_rc)"
+fi
+rm -rf "$forensic_dir"
+
 echo "== ci_smoke: pod soak (sharded ckpt, kill-and-resume, reshard) =="
 # pod-resilience gate (docs/robustness.md): two sharded-checkpoint
 # trainers over one directory; wave 1 SIGKILLs a worker mid-run (the
@@ -482,7 +514,8 @@ tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'kernel_fallbacks', 'emitter_fallbacks',
                 'kernelgen_ops', 'kernelgen_fallbacks', 'fused_adam_ms',
                 'host_blocked_s', 'nan_poll_lag_steps',
-                'prefetch_upload_overlap_s']
+                'prefetch_upload_overlap_s', 'forensics_replays',
+                'quarantined_samples']
 tel_missing = [k for k in tel_expected if k not in tel]
 if tel_missing:
     sys.exit('ci_smoke: telemetry block is missing keys: %s' % tel_missing)
@@ -596,5 +629,6 @@ fi
     [ "$kg_zoo_rc" -eq 0 ] && \
     [ "$soak_rc" -eq 0 ] && \
     [ "$resume_rc" -eq 0 ] && [ "$async_rc" -eq 0 ] && \
+    [ "$forensic_rc" -eq 0 ] && [ "$forensic_async_rc" -eq 0 ] && \
     [ "$pod_rc" -eq 0 ] && \
     [ "$serve_rc" -eq 0 ] && [ "$decode_rc" -eq 0 ]
