@@ -185,11 +185,17 @@ def bench_resnet50(on_tpu, device_kind):
     B = int(os.environ.get('BENCH_RESNET_B', 128 if on_tpu else 2))
     side = 224 if on_tpu else 32
     classes = 1000 if on_tpu else 10
+    # same CPU-smoke story as the transformer dims: 25M resnet50 params
+    # through the interpret-mode fused-optimizer kernel is minutes/step,
+    # so CI drops to the 0.27M-param cifar10 variant
+    depth = int(os.environ.get('BENCH_RESNET_DEPTH', '50'))
+    data_set = os.environ.get('BENCH_RESNET_SET', 'imagenet')
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         with fluid.unique_name.guard():
             out = resnet.build(data_shape=(3, side, side),
-                               class_dim=classes, depth=50, lr=0.1)
+                               class_dim=classes, depth=depth, lr=0.1,
+                               data_set=data_set)
     main_prog.set_amp(True)
     exe = fluid.Executor()
     scope = fluid.Scope()
@@ -267,11 +273,24 @@ def main():
     platform, kind_or_reason = probe_backend()
     probe_s = round(time.perf_counter() - t_probe, 1)
     fallback_reason = None
+    if platform != 'tpu' and \
+            os.environ.get('BENCH_ALLOW_CPU', '0') not in ('1', 'true'):
+        # backend != tpu is a structured FAILURE by default: silently
+        # recording CPU numbers as if they were TPU numbers cost two
+        # bench rounds (BENCH_r02/r05).  CI smoke runs opt in explicitly
+        # with BENCH_ALLOW_CPU=1.
+        reason = kind_or_reason if platform is None else \
+            "probe reached backend '%s', not tpu" % platform
+        print('BENCH: backend is not TPU — %s' % reason, file=sys.stderr)
+        print('BENCH: set BENCH_ALLOW_CPU=1 to record CPU numbers '
+              'anyway', file=sys.stderr)
+        _emit_error('cpu_fallback', reason)
+        return 3
     if platform is None:
         fallback_reason = kind_or_reason
         print('BENCH: TPU backend probe FAILED — %s' % fallback_reason,
               file=sys.stderr)
-        print('BENCH: falling back to CPU so a number still lands',
+        print('BENCH: BENCH_ALLOW_CPU=1 — falling back to CPU',
               file=sys.stderr)
         device_kind = 'cpu-fallback'
     else:
@@ -287,11 +306,18 @@ def main():
     from paddle_tpu.models import transformer as tr
 
     on_tpu = platform not in (None, 'cpu')
-    # transformer-base; dropout off so training uses the fused flash kernel
+    # transformer-base; dropout off so training uses the fused flash kernel.
+    # The model dims are overridable because the kernelgen interpret tier
+    # pays per PARAMETER on CPU (the fused-Adam kernel walks every param
+    # group through the Pallas interpreter, ~minutes/step at 25M params) —
+    # CI smoke must shrink the model itself, not just B/T.
     B = int(os.environ.get('BENCH_B', 32 if on_tpu else 4))
     T = int(os.environ.get('BENCH_T', 256 if on_tpu else 64))
-    vocab = 32000
-    n_layer, n_head, d_model, d_inner = 6, 8, 512, 2048
+    vocab = int(os.environ.get('BENCH_VOCAB', '32000'))
+    n_layer = int(os.environ.get('BENCH_LAYERS', '6'))
+    n_head = int(os.environ.get('BENCH_HEADS', '8'))
+    d_model = int(os.environ.get('BENCH_DMODEL', '512'))
+    d_inner = int(os.environ.get('BENCH_DINNER', '2048'))
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):

@@ -239,13 +239,16 @@ def _replay_fused(ins, attrs, amp, mesh, key, streams):
             vals = [env[n] for n in names]
             ins2[slot] = vals if sub['input_is_list'].get(slot) else vals[0]
         if amp:
-            ins2 = _ex._amp_match_ins(sub['type'], ins2)
+            ins2 = _ex._amp_sub_ins(sub['type'], ins2, amp)
         if sub['type'] in RNG_OPS:
             sctx = EmitCtx(key, streams[si], amp, mesh, sub['type'])
             si += 1
         else:
             sctx = EmitCtx(key, None, amp, mesh, sub['type'])
         outs = fn(sctx, ins2, sub['attrs']) or {}
+        if amp:
+            outs = _ex._amp_sub_outs(sub['type'], sub['attrs'], outs,
+                                     amp)
         stop = set(sub.get('stop_grad') or ())
         for slot, names in sub['outputs'].items():
             if slot not in outs:

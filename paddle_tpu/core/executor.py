@@ -197,6 +197,38 @@ def _amp_match_ins(op_type, ins):
             for s, v in ins.items()}
 
 
+def _amp_sub_ins(op_type, ins, amp):
+    """The FULL per-op AMP input policy the trace loop below applies,
+    for replayed sub-ops (ops/fused.py, the emitter's _replay_fused, the
+    kernelgen dedicated steps): _AMP_OPS get every input cast to bf16
+    before dispatch, then the elementwise-match glue runs.  A fused
+    group containing e.g. flash_attention must see the same activations
+    it would have unfused."""
+    import jax.numpy as jnp
+    if not amp:
+        return ins
+    if op_type in _AMP_OPS:
+        ins = {s: ([_amp_cast(v, jnp.bfloat16) for v in vs]
+                   if isinstance(vs, (list, tuple))
+                   else _amp_cast(vs, jnp.bfloat16))
+               for s, vs in ins.items()}
+    return _amp_match_ins(op_type, ins)
+
+
+def _amp_sub_outs(op_type, attrs, outs, amp):
+    """The cast-back half: _AMP_CAST_OPS outputs return to f32 unless
+    the op carries the amp_keep_bf16 opt-out — exactly the trace loop's
+    policy, applied at the sub-op granularity of a fused replay."""
+    import jax.numpy as jnp
+    if not (amp and op_type in _AMP_CAST_OPS and outs) \
+            or attrs.get('amp_keep_bf16'):
+        return outs
+    return {s: ([_amp_cast(v, jnp.float32) for v in vs]
+                if isinstance(vs, (list, tuple))
+                else _amp_cast(vs, jnp.float32))
+            for s, vs in outs.items()}
+
+
 class ForensicProbes(object):
     """Trace-time collector for the per-op finite-probe lowering
     (train/forensics.py, PT_FORENSIC).

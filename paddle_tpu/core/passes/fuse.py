@@ -28,13 +28,21 @@ Bitwise parity with the unfused program is preserved by construction:
 """
 import numpy as np
 
-__all__ = ['run', 'FUSABLE_OPS', 'FUSED_OP']
+__all__ = ['run', 'FUSABLE_OPS', 'FUSED_OP', 'KERNEL_TIER_OPS']
 
 FUSED_OP = 'fused_elementwise'
 
+# reduction/attention ops the kernelgen tier lowers through DEDICATED
+# generated kernels (row reductions, flash attention — KERNEL_RULES
+# kinds 'row'/'attention').  They fuse like any elementwise op, and
+# unlike pure glue they justify a fused group even as a SINGLETON run:
+# a lone softmax between two matmuls must still reach the kernel tier.
+KERNEL_TIER_OPS = {'softmax', 'layer_norm', 'flash_attention'}
+
 # unary/binary elementwise math + zero-flop glue + per-param optimizer
 # updates (elementwise over the param): anything whose kernel is pure,
-# rng-stable (via rng_stream), and free of cross-element reductions
+# rng-stable (via rng_stream), and — KERNEL_TIER_OPS excepted — free of
+# cross-element reductions
 FUSABLE_OPS = {
     # elementwise binary
     'elementwise_add', 'elementwise_sub', 'elementwise_mul',
@@ -61,7 +69,7 @@ FUSABLE_OPS = {
     # per-param optimizer updates
     'sgd', 'momentum', 'adam', 'adamax', 'adagrad', 'decayed_adagrad',
     'adadelta', 'rmsprop', 'ftrl',
-}
+} | KERNEL_TIER_OPS
 
 # never nest: keeps the pipeline idempotent and the impl non-recursive
 assert FUSED_OP not in FUSABLE_OPS
@@ -209,7 +217,8 @@ def run(program, ctx):
                     break
                 run_ops.append((nxt, ndesc))
                 j += 1
-            if len(run_ops) < 2:
+            if len(run_ops) < 2 and not any(
+                    o.type in KERNEL_TIER_OPS for o, _ in run_ops):
                 i = j
                 continue
             lo, hi = i, j  # [lo, hi) is the run

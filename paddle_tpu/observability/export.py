@@ -113,6 +113,9 @@ SCHEMA = {
         ('emitter_fallbacks', ('int', 'emitter.fallbacks')),
         ('kernelgen_ops', ('int', 'kernelgen.ops')),
         ('kernelgen_fallbacks', ('int', 'kernelgen.fallbacks')),
+        ('autotune_searches', ('int', 'kernelgen.autotune_searches')),
+        ('autotune_cache_hits', ('int',
+                                 'kernelgen.autotune_cache_hits')),
         ('fused_adam_ms', ('extra',)),
         ('host_blocked_s', ('sec', 'executor.host_blocked_s')),
         ('nan_poll_lag_steps', ('int', 'nan_poll.lag_steps')),

@@ -10,10 +10,12 @@ The op's attrs carry the fused run:
 Replaying through each sub-op's own registered kernel, in order, emits
 the IDENTICAL jaxpr the unfused executor loop would have — bitwise
 parity is by construction.  The three pieces of executor-loop policy
-that apply per op are replicated here: the AMP elementwise-match cast
-(core/executor._amp_match_ins), per-output stop_gradient, and RNG
-streams (ctx.sub_ctx derives each sub-op's stream from its pinned
-``rng_stream`` attr).
+that apply per op are replicated here: the full per-op AMP cast policy
+(core/executor._amp_sub_ins/_amp_sub_outs — the _AMP_OPS bf16 in-cast,
+elementwise-match glue, and _AMP_CAST_OPS f32 cast-back, so a fused
+flash_attention sees exactly the unfused dtypes), per-output
+stop_gradient, and RNG streams (ctx.sub_ctx derives each sub-op's
+stream from its pinned ``rng_stream`` attr).
 """
 import jax.numpy as jnp
 from jax import lax
@@ -28,10 +30,13 @@ def _run_sub_op(ctx, sub, env, amp):
         vals = [env[n] for n in names]
         ins[slot] = vals if sub['input_is_list'].get(slot) else vals[0]
     if amp:
-        from ..core.executor import _amp_match_ins
-        ins = _amp_match_ins(sub['type'], ins)
+        from ..core.executor import _amp_sub_ins
+        ins = _amp_sub_ins(sub['type'], ins, amp)
     sctx = ctx.sub_ctx(sub) if hasattr(ctx, 'sub_ctx') else ctx
     outs = impl(sctx, ins, sub['attrs']) or {}
+    if amp:
+        from ..core.executor import _amp_sub_outs
+        outs = _amp_sub_outs(sub['type'], sub['attrs'], outs, amp)
     stop = set(sub.get('stop_grad') or ())
     for slot, names in sub['outputs'].items():
         if slot not in outs:

@@ -15,9 +15,13 @@ Both paths fall back LOUDLY through ops/_fallback.py on any failure
 (``kernelgen.fallbacks`` counter, warn-once, ``PT_STRICT_KERNELS=1``
 raises naming the unsupported sub-op) to the bitwise-reference replay.
 
-Env vars: ``PT_KERNELGEN`` (default 0), ``PT_KERNELGEN_BLOCK`` (base
-block size, default 1024), ``PT_KERNELGEN_INTERPRET`` (force/forbid
-interpret mode; default: interpret unless the backend is TPU).
+Env vars (docs/kernels.md has the full table): ``PT_KERNELGEN``
+(default: ON when the backend is TPU, OFF elsewhere — an explicit 0/1
+always wins; the interpret-mode tier is a CPU test vehicle, ~9x slower
+than XLA fusion), ``PT_KERNELGEN_BLOCK`` (static base block size,
+default 1024), ``PT_KERNELGEN_INTERPRET`` (force/forbid interpret
+mode; default: interpret unless the backend is TPU), ``PT_AUTOTUNE``
+(0/1/cached — kernelgen/autotune.py block-size search + persistence).
 """
 import os
 
@@ -33,24 +37,43 @@ __all__ = ['KERNEL_RULES', 'KernelgenUnsupported', 'KERNELGEN_VERSION',
 
 # bump on any change to plan building / kernel emission semantics: it
 # feeds the compile-cache fingerprint and the emitter memo key
-KERNELGEN_VERSION = 1
+KERNELGEN_VERSION = 2
 
 
 def enabled():
-    return os.environ.get('PT_KERNELGEN', '0') in ('1', 'true', 'True')
+    """Default ON when the backend is TPU (the tier IS the compute path
+    there); default OFF elsewhere, where kernels would run under the
+    Pallas interpreter — a bitwise test vehicle, not a fast path.  An
+    explicit PT_KERNELGEN always wins, both directions."""
+    v = os.environ.get('PT_KERNELGEN')
+    if v is None:
+        import jax
+        return jax.default_backend() == 'tpu'
+    return v in ('1', 'true', 'True')
 
 
 def config_token():
-    """Launch-signature / emitter-memo component: is the tier on, and
-    which codegen generation is it."""
-    return ('kernelgen', 1 if enabled() else 0, KERNELGEN_VERSION)
+    """Launch-signature / emitter-memo component: is the tier on, which
+    codegen generation, and the autotune mode (a mode flip can change
+    every kernel's block shapes, so memoized traces must not survive
+    it)."""
+    from . import autotune
+    return ('kernelgen', 1 if enabled() else 0, KERNELGEN_VERSION,
+            autotune.mode())
 
 
 def fingerprint_extra():
     """AOT disk-cache fingerprint component: version + rule coverage
     (a new rule changes which sub-programs lower, so cached executables
-    from an older table must not be reused)."""
-    return ('kernelgen', KERNELGEN_VERSION, rule_names())
+    from an older table must not be reused) + autotune mode (tuned and
+    untuned builds compile different block shapes)."""
+    return ('kernelgen', KERNELGEN_VERSION, rule_names(),
+            _autotune_mode())
+
+
+def _autotune_mode():
+    from . import autotune
+    return autotune.mode()
 
 
 def unsupported_sub_ops(attrs):
@@ -105,7 +128,8 @@ def _keys_for(attrs, keyfn):
 def _note_ok(plan):
     from ...observability import metrics
     metrics.counter('kernelgen.ops').inc()
-    metrics.counter('kernelgen.kernels').inc(plan.n_kernels)
+    metrics.counter('kernelgen.kernels').inc(
+        plan.n_kernels + plan.n_dsteps)
 
 
 def _xs_of(ins):
@@ -118,10 +142,12 @@ def run_fused(ctx, ins, attrs):
     (ctx.sub_ctx(sub).rng() — the replay path's exact keys).  Ctxs
     without sub-op streams (the lint abstract interpreter's InferCtx)
     draw from ctx.rng() directly, exactly like the replay path's
-    hasattr guard — shapes are all that survive eval_shape anyway."""
+    hasattr guard — shapes are all that survive eval_shape anyway, so
+    they also must never trigger a timed autotune search."""
     xs = _xs_of(ins)
     amp = bool(getattr(ctx, 'amp', False))
-    plan = plan_for(attrs, _in_avals(xs), amp)
+    plan = plan_for(attrs, _in_avals(xs), amp,
+                    allow_search=hasattr(ctx, 'sub_ctx'))
     keys = _keys_for(
         attrs,
         lambda si, sub: (ctx.sub_ctx(sub) if hasattr(ctx, 'sub_ctx')

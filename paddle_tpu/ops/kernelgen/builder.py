@@ -14,10 +14,22 @@ plan) and partitions them into three step kinds:
     These are zero-flop data-movement XLA ops; elementwise math commutes
     with them lane-for-lane, so hoisting them BETWEEN kernels preserves
     bitwise parity while keeping every compute op inside a kernel.
+``dstep``
+    A dedicated whole-op kernel (rule kinds ``row``/``attention``:
+    softmax, layer_norm, flash_attention).  The op's logical inputs are
+    materialized, its ``rule.step`` runs one generated kernel (a row
+    reduction or the flash-attention call), and its outputs re-enter the
+    plan as materialized values — with the executor's per-sub-op AMP
+    cast policy (core/executor._amp_sub_ins/_amp_sub_outs) applied
+    around the step exactly as the replay path applies it.  Block
+    shapes come from kernelgen/autotune.py (searched + persisted per
+    signature; ``rule.tune`` declares the candidates).
 ``kernel``
     A maximal run of elementwise/optimizer/rng-body sub-ops lowered into
     ONE ``pl.pallas_call``.  Every tensor is flattened to 1-D and tiled
-    over a single grid axis:
+    over a single grid axis (the base block size is autotuned per
+    segment signature, static ``PT_KERNELGEN_BLOCK`` under
+    ``PT_AUTOTUNE=0``):
 
     * values are grouped by flat element count; each group g gets block
       ``b_g = min(BLOCK, N_g)`` (lcm-lifted over any broadcast divisors)
@@ -76,11 +88,21 @@ def _block_base():
 
 
 def _interpret():
-    v = os.environ.get('PT_KERNELGEN_INTERPRET')
-    if v is not None:
-        return v in ('1', 'true', 'True')
     import jax
-    return jax.default_backend() != 'tpu'
+    on_tpu = jax.default_backend() == 'tpu'
+    v = os.environ.get('PT_KERNELGEN_INTERPRET')
+    if v is None:
+        return not on_tpu
+    want = v in ('1', 'true', 'True')
+    if not want and not on_tpu:
+        # an explicit =0 means "real Mosaic lowering" — impossible off
+        # TPU; raising here (not deep inside a Mosaic error) keeps the
+        # misconfiguration loud instead of silently interpreting
+        raise KernelgenUnsupported(
+            'kernelgen',
+            'PT_KERNELGEN_INTERPRET=0 but the backend is %r — no TPU, '
+            'interpret disabled' % jax.default_backend())
+    return want
 
 
 _RNG_TYPES = None
@@ -239,7 +261,7 @@ class _Seg(object):
 
 class _Plan(object):
     __slots__ = ('fn', 'n_rng', 'n_kernels', 'n_glue', 'kernel_ops',
-                 'groups', 'n_donated')
+                 'groups', 'n_donated', 'n_dsteps', 'tuned')
 
 
 _PLANS = {}
@@ -249,19 +271,117 @@ def clear_plans():
     _PLANS.clear()
 
 
-def plan_for(attrs, in_avals, amp):
-    """Build-or-fetch the plan for one canonical fused signature."""
+def plan_for(attrs, in_avals, amp, allow_search=True):
+    """Build-or-fetch the plan for one canonical fused signature.
+
+    ``allow_search=False`` callers (the lint abstract interpreter, which
+    reaches here under eval_shape) get a plan built on cached/default
+    autotune choices — never a timed search."""
     from ...core.emit.emitter import _canon_attrs
+    from . import autotune
     key = (_canon_attrs('fused_elementwise', attrs), tuple(in_avals),
-           bool(amp), _interpret(), _block_base())
+           bool(amp), _interpret(), _block_base(), autotune.mode(),
+           bool(allow_search))
     plan = _PLANS.get(key)
     if plan is None:
-        plan = _build_plan(attrs, tuple(in_avals), bool(amp))
+        plan = _build_plan(attrs, tuple(in_avals), bool(amp),
+                           bool(allow_search))
         _PLANS[key] = plan
     return plan
 
 
-def _build_plan(attrs, in_avals, amp):
+def _blocks_for(base, groups):
+    """Effective per-group block map for a candidate base block size
+    (None when some lcm lift would exceed the VMEM cap)."""
+    blocks = {}
+    for g, ds in sorted(groups.items()):
+        b = base
+        for D in sorted(ds):
+            b = _lcm(b, D)
+            if b > _BLOCK_CAP:
+                return None
+        if g <= b:
+            b = g              # g is a multiple of every D by compat
+        blocks[g] = b
+    return blocks
+
+
+def _tuned_base(s, esc, amp, reads, final_keys, allow_search):
+    """Autotuned base block size for one elementwise segment: candidate
+    bases are deduped by the *effective* per-group block map, each is
+    compiled + timed on synthesized inputs, the winner persists per
+    segment signature (kernelgen/autotune.py).  Degenerate segments
+    (scalar-only, one effective config, or giant interpret-mode groups)
+    keep the static default with zero overhead."""
+    from . import autotune
+    static = _block_base()
+    sizes = [g for g in s.groups if g > 1]
+    if autotune.mode() == '0' or not sizes:
+        return static
+    if _interpret() and max(sizes) > autotune.interpret_size_cap():
+        return static
+    cands, seen = [], set()
+    for b in dict.fromkeys((static, 256, 1024, 4096)):
+        eff = _blocks_for(b, s.groups)
+        if eff is None:
+            continue
+        ek = tuple(sorted(eff.items()))
+        if ek in seen:
+            continue
+        seen.add(ek)
+        cands.append({'base': b})
+    if len(cands) <= 1:
+        return static
+    sig = ('ew',
+           tuple(op[0]['type'] for op in s.ops),
+           tuple((kind, size, s.entry_dt[ix])
+                 for ix, (mid, kind, size) in enumerate(s.entries)),
+           tuple((g, tuple(sorted(ds)))
+                 for g, ds in sorted(s.groups.items())),
+           tuple((_size(s.key_aval[k][0]), s.key_aval[k][1])
+                 for k in esc),
+           bool(amp), _interpret())
+
+    def timer(cand):
+        scratch = {'donated': 0}
+        kspec = _compile_segment(s, esc, amp, reads, final_keys,
+                                 scratch, cand['base'])
+
+        def thunk():
+            args = [autotune.synth_value((size,), s.entry_dt[ix])
+                    for ix, (mid, kind, size)
+                    in enumerate(kspec['entries'])]
+            return kspec['call'](*args)
+
+        return autotune.time_thunk(thunk)
+
+    choice = autotune.choose('ew', sig, cands, timer, {'base': static},
+                             allow_search)
+    return int(choice['base'])
+
+
+def _tune_step(stype, rule, sattrs, avals_d, allow_search):
+    """Resolve one dedicated step's autotune choice (None = rule has no
+    tuner / nothing viable: step uses its own defaults)."""
+    from . import autotune
+    if rule.tune is None or autotune.mode() == '0':
+        return None
+    interp = _interpret()
+    spec = rule.tune(sattrs, _AvalsView(avals_d), interp)
+    if not spec:
+        return None
+
+    def timer(cand):
+        def thunk():
+            return rule.step(spec['make_ins'](), sattrs,
+                             _AvalsView(avals_d), cand, interp)
+        return autotune.time_thunk(thunk)
+
+    return autotune.choose(stype, spec['signature'], spec['candidates'],
+                           timer, spec.get('default'), allow_search)
+
+
+def _build_plan(attrs, in_avals, amp, allow_search=True):
     import jax
     import jax.numpy as jnp
     from .rules import KERNEL_RULES
@@ -305,8 +425,10 @@ def _build_plan(attrs, in_avals, amp):
     mid_next = [len(arg_names)]
     steps = []
     seg = [None]
-    stats = {'kernels': 0, 'kernel_ops': 0, 'glue': 0, 'donated': 0}
+    stats = {'kernels': 0, 'kernel_ops': 0, 'glue': 0, 'donated': 0,
+             'dsteps': 0}
     all_groups = []
+    tuned = []
 
     def new_mid():
         mid_next[0] += 1
@@ -324,7 +446,11 @@ def _build_plan(attrs, in_avals, amp):
                if lastuse.get(k, -1) >= upto or k in final_keys]
         if not esc:
             return             # fully dead segment: drop it
-        kspec = _compile_segment(s, esc, amp, reads, final_keys, stats)
+        sbase = _tuned_base(s, esc, amp, reads, final_keys,
+                            allow_search)
+        tuned.append(sbase)
+        kspec = _compile_segment(s, esc, amp, reads, final_keys, stats,
+                                 sbase)
         for k in esc:
             mid = new_mid()
             loc[k] = ('mat', mid)
@@ -402,6 +528,40 @@ def _build_plan(attrs, in_avals, amp):
             cur[out_name] = ok[1]
             loc[ok] = ('mat', mid)
             aval[ok] = (tuple(v.shape), str(v.dtype))
+            continue
+
+        # -------------------- dedicated whole-op kernels (row/attention)
+        if rule.kind in ('row', 'attention'):
+            if any(loc[key_of(n)][0] == 'sym'
+                   for names in sub['inputs'].values() for n in names):
+                _flush(i)
+            in_mids, in_avals_d = {}, {}
+            for slot, names in sub['inputs'].items():
+                in_mids[slot] = [_as_mat(key_of(n)) for n in names]
+                if names:
+                    in_avals_d[slot] = aval[key_of(names[0])]
+            tune = _tune_step(stype, rule, sub['attrs'], in_avals_d,
+                              allow_search)
+            if tune is not None:
+                tuned.append(tune)
+            out_bind = {}
+            for slot, names in sub['outputs'].items():
+                binds = []
+                for n in names:
+                    if n not in written:
+                        binds.append(None)
+                        continue
+                    v = written[n]
+                    mid = new_mid()
+                    ok = (n, cur.get(n, 0) + 1)
+                    cur[n] = ok[1]
+                    loc[ok] = ('mat', mid)
+                    aval[ok] = (tuple(v.shape), str(v.dtype))
+                    binds.append(mid)
+                out_bind[slot] = binds
+            steps.append(('dstep', sub, rule, in_mids, out_bind,
+                          dict(in_avals_d), tune))
+            stats['dsteps'] += 1
             continue
 
         # --------------------------------------- in-kernel compute op
@@ -546,8 +706,10 @@ def _build_plan(attrs, in_avals, amp):
         finals.append(where[1])
 
     n_args = len(arg_names)
+    interp = _interpret()
 
     def core(xs, keys):
+        from ...core import executor as _ex
         mats = {}
         for ix in range(n_args):
             mats[ix] = xs[ix]
@@ -560,6 +722,31 @@ def _build_plan(attrs, in_avals, amp):
             elif kind == 'glue':
                 _, mid, fn, ins_ = st
                 mats[mid] = fn(*[mats[m] for m in ins_])
+            elif kind == 'dstep':
+                _, sub, rule, in_mids, out_bind, avals_d, tune = st
+                ins_vals = {}
+                for slot, mids_ in in_mids.items():
+                    vals = [mats[m] for m in mids_]
+                    ins_vals[slot] = vals \
+                        if sub['input_is_list'].get(slot) else vals[0]
+                if amp:
+                    ins_vals = _ex._amp_sub_ins(sub['type'], ins_vals,
+                                                amp)
+                outs = rule.step(ins_vals, sub['attrs'],
+                                 _AvalsView(avals_d), tune, interp) \
+                    or {}
+                if amp:
+                    outs = _ex._amp_sub_outs(sub['type'], sub['attrs'],
+                                             outs, amp)
+                for slot, binds in out_bind.items():
+                    if slot not in outs:
+                        continue
+                    vals = outs[slot]
+                    vals = vals if isinstance(vals, (list, tuple)) \
+                        else [vals]
+                    for mid, v in zip(binds, vals):
+                        if mid is not None and v is not None:
+                            mats[mid] = v
             else:
                 _run_kernel(st[1], mats)
         return [mats[m] for m in finals]
@@ -599,29 +786,23 @@ def _build_plan(attrs, in_avals, amp):
     plan.kernel_ops = stats['kernel_ops']
     plan.n_donated = stats['donated']
     plan.groups = all_groups
+    plan.n_dsteps = stats['dsteps']
+    plan.tuned = tuned
     return plan
 
 
 # ---------------------------------------------------- pallas emission
-def _compile_segment(s, esc, amp, reads, final_keys, stats):
+def _compile_segment(s, esc, amp, reads, final_keys, stats, base=None):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    base = _block_base()
-    blocks, tiles = {}, {}
-    for g, ds in sorted(s.groups.items()):
-        b = base
-        for D in sorted(ds):
-            b = _lcm(b, D)
-            if b > _BLOCK_CAP:
-                raise KernelgenUnsupported(
-                    'broadcast',
-                    'block lcm %d exceeds cap %d' % (b, _BLOCK_CAP))
-        if g <= b:
-            b = g              # g is a multiple of every D by compat
-        blocks[g] = b
-        tiles[g] = -(-g // b)
+    blocks = _blocks_for(_block_base() if base is None else base,
+                         s.groups)
+    if blocks is None:
+        raise KernelgenUnsupported(
+            'broadcast', 'block lcm exceeds cap %d' % _BLOCK_CAP)
+    tiles = {g: -(-g // b) for g, b in blocks.items()}
     grid = max(tiles.values()) if tiles else 1
 
     outs_meta = []             # (key, n, group-or-None, shape, dt)

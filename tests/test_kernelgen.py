@@ -371,9 +371,12 @@ def test_grad_through_generated_kernel_matches_replay():
 # ----------------------------------------------- loud fallback contract
 
 def _unsupported_attrs():
+    # reduce_sum: registered op, no KERNEL_RULES entry (softmax no
+    # longer qualifies — it graduated to a dedicated row kernel)
     return _attrs(
         [_sub('scale', {'X': ['x']}, {'Out': ['a']}, {'scale': 2.0}),
-         _sub('softmax', {'X': ['a']}, {'Out': ['o']}, {'axis': -1})],
+         _sub('reduce_sum', {'X': ['a']}, {'Out': ['o']},
+              {'dim': [-1], 'keep_dim': False})],
         ['x'], ['o'])
 
 
@@ -397,7 +400,7 @@ def test_strict_kernels_raises_naming_sub_op(monkeypatch):
         get_op('fused_elementwise').impl(_PlainCtx(), {'X': [x]},
                                          _unsupported_attrs())
     msg = str(ei.value)
-    assert 'softmax' in msg and 'PT_STRICT_KERNELS' in msg
+    assert 'reduce_sum' in msg and 'PT_STRICT_KERNELS' in msg
 
 
 def test_fallback_counts_warns_once_and_replays(monkeypatch):
@@ -416,10 +419,10 @@ def test_fallback_counts_warns_once_and_replays(monkeypatch):
             _PlainCtx(), {'X': [x]}, _unsupported_attrs())
     relevant = [x for x in w if 'kernelgen' in str(x.message)]
     assert len(relevant) == 1, 'fallback must warn exactly once'
-    assert 'softmax' in str(relevant[0].message)
+    assert 'reduce_sum' in str(relevant[0].message)
     after = obs.counters().get('kernelgen.fallbacks') or 0
     assert after == before + 2
-    want = jax.nn.softmax(x * 2.0, axis=-1)
+    want = jnp.sum(x * 2.0, axis=-1)
     np.testing.assert_allclose(np.asarray(out['Out'][0]),
                                np.asarray(want), rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(out['Out'][0]),
@@ -427,10 +430,212 @@ def test_fallback_counts_warns_once_and_replays(monkeypatch):
 
 
 def test_unsupported_sub_ops_lists_gaps_once():
-    assert kg.unsupported_sub_ops(_unsupported_attrs()) == ['softmax']
+    assert kg.unsupported_sub_ops(_unsupported_attrs()) == ['reduce_sum']
     assert kg.unsupported_sub_ops(
         _attrs([_sub('relu', {'X': ['x']}, {'Out': ['o']})],
                ['x'], ['o'])) == []
+
+
+# ----------------------- dedicated kernels: row + attention kinds
+
+def test_softmax_row_kernel_bitwise_with_grad():
+    rng = np.random.RandomState(13)
+    x = _rand(rng, (6, 33))  # 33 cols + 6 rows: ragged row-block grid
+    attrs = _attrs(
+        [_sub('softmax', {'X': ['x']}, {'Out': ['o']}, {'axis': -1})],
+        ['x'], ['o'])
+    plan = _assert_plan_bitwise(attrs, [x])
+    assert plan.n_dsteps == 1
+    gk = jax.jit(jax.grad(
+        lambda v: jnp.sum(plan.fn((v,), ())[0] ** 2)))(x)
+    gr = jax.jit(jax.grad(
+        lambda v: jnp.sum(_replay(attrs, (v,), ())[0] ** 2)))(x)
+    np.testing.assert_array_equal(np.asarray(gk), np.asarray(gr))
+
+
+def test_softmax_3d_trailing_axis_and_fused_neighbors():
+    rng = np.random.RandomState(14)
+    x = _rand(rng, (2, 5, 9))
+    attrs = _attrs(
+        [_sub('scale', {'X': ['x']}, {'Out': ['a']}, {'scale': 1.7}),
+         _sub('softmax', {'X': ['a']}, {'Out': ['s']}, {'axis': -1}),
+         _sub('relu', {'X': ['s']}, {'Out': ['o']})],
+        ['x'], ['o'])
+    plan = _assert_plan_bitwise(attrs, [x])
+    assert plan.n_dsteps == 1
+
+
+def test_layer_norm_row_kernel_three_outputs_and_grads():
+    rng = np.random.RandomState(15)
+    x = _rand(rng, (6, 10))
+    scale, bias = _rand(rng, (10,)), _rand(rng, (10,))
+    attrs = _attrs(
+        [_sub('layer_norm', {'X': ['x'], 'Scale': ['s'], 'Bias': ['b']},
+              {'Y': ['y'], 'Mean': ['m'], 'Variance': ['v']},
+              {'begin_norm_axis': 1, 'epsilon': 1e-5},
+              stop_grad=['m', 'v'])],
+        ['x', 's', 'b'], ['y', 'm', 'v'])
+    plan = _assert_plan_bitwise(attrs, [x, scale, bias])
+    assert plan.n_dsteps == 1
+    # AMP policy reproduced (executor _amp_sub_ins/_amp_sub_outs)
+    _assert_plan_bitwise(attrs, [x, scale, bias], amp=True)
+    gk = jax.jit(jax.grad(
+        lambda a, s, b: jnp.sum(plan.fn((a, s, b), ())[0] ** 2),
+        argnums=(0, 1, 2)))(x, scale, bias)
+    gr = jax.jit(jax.grad(
+        lambda a, s, b: jnp.sum(_replay(attrs, (a, s, b), ())[0] ** 2),
+        argnums=(0, 1, 2)))(x, scale, bias)
+    for name, a, b in zip(('dx', 'dscale', 'dbias'), gk, gr):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
+def test_layer_norm_two_pass_env_still_bitwise(monkeypatch):
+    monkeypatch.setenv('PT_TWO_PASS_NORM', '1')
+    kg.clear_plan_cache()
+    try:
+        rng = np.random.RandomState(16)
+        x = _rand(rng, (4, 8))
+        attrs = _attrs(
+            [_sub('layer_norm', {'X': ['x']},
+                  {'Y': ['y'], 'Mean': ['m'], 'Variance': ['v']},
+                  {'begin_norm_axis': 1, 'epsilon': 1e-5},
+                  stop_grad=['m', 'v'])],
+            ['x'], ['y', 'm', 'v'])
+        _assert_plan_bitwise(attrs, [x])
+    finally:
+        kg.clear_plan_cache()
+
+
+def test_flash_attention_plan_matches_replay_with_grads():
+    """The dstep passes through ops/attention.flash_attention — same
+    custom_vjp as the registered impl, so fwd AND grads are bitwise."""
+    rng = np.random.RandomState(17)
+    q, k, v = (_rand(rng, (2, 2, 16, 8)) for _ in range(3))
+    attrs = _attrs(
+        [_sub('flash_attention', {'Q': ['q'], 'K': ['k'], 'V': ['v']},
+              {'Out': ['o']}, {'causal': True})],
+        ['q', 'k', 'v'], ['o'])
+    plan = _assert_plan_bitwise(attrs, [q, k, v])
+    assert plan.n_dsteps == 1
+    gk = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(plan.fn((a, b, c), ())[0] ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(_replay(attrs, (a, b, c), ())[0] ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip(('dq', 'dk', 'dv'), gk, gr):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
+# ------------------------------------------------- tile/block autotuner
+
+def _softmax_attrs():
+    return _attrs(
+        [_sub('softmax', {'X': ['x']}, {'Out': ['o']}, {'axis': -1})],
+        ['x'], ['o'])
+
+
+def _autotune_counters():
+    c = obs.counters()
+    return (c.get('kernelgen.autotune_searches') or 0,
+            c.get('kernelgen.autotune_cache_hits') or 0)
+
+
+def test_autotune_searches_once_persists_and_is_deterministic(
+        tmp_path, monkeypatch):
+    from paddle_tpu.ops.kernelgen import autotune
+    monkeypatch.setenv('PT_CACHE', '1')
+    monkeypatch.setenv('PT_CACHE_DIR', str(tmp_path))
+    monkeypatch.setenv('PT_AUTOTUNE', '1')
+    kg.clear_plan_cache()
+    autotune.clear_memory()
+    try:
+        rng = np.random.RandomState(18)
+        x = _rand(rng, (64, 16))  # 64 rows: {8, 32, 64} row candidates
+        attrs = _softmax_attrs()
+        s0, h0 = _autotune_counters()
+        plan1 = _assert_plan_bitwise(attrs, [x])
+        s1, _ = _autotune_counters()
+        assert s1 > s0, 'cold build must pay a timed search'
+        assert plan1.tuned and 'block_rows' in plan1.tuned[0]
+        store = os.path.join(str(tmp_path), 'autotune')
+        assert os.path.isdir(store) and os.listdir(store), \
+            'the winning choice must persist in the AOT cache dir'
+        # simulate a fresh process: drop the plan cache and the memo;
+        # the disk store answers — zero new searches, identical choice
+        kg.clear_plan_cache()
+        autotune.clear_memory()
+        plan2 = kg.plan_for(attrs, kg._in_avals([x]), False)
+        s2, h2 = _autotune_counters()
+        assert s2 == s1, 'warm rebuild must not re-search'
+        assert h2 > h0, 'warm rebuild must hit the persisted store'
+        assert plan2.tuned == plan1.tuned
+    finally:
+        kg.clear_plan_cache()
+        autotune.clear_memory()
+
+
+def test_autotune_cached_mode_uses_static_default(monkeypatch):
+    from paddle_tpu.ops.kernelgen import autotune
+    monkeypatch.setenv('PT_AUTOTUNE', 'cached')
+    monkeypatch.setenv('PT_CACHE', '0')
+    kg.clear_plan_cache()
+    autotune.clear_memory()
+    try:
+        rng = np.random.RandomState(19)
+        x = _rand(rng, (64, 16))
+        s0, _ = _autotune_counters()
+        plan = _assert_plan_bitwise(_softmax_attrs(), [x])
+        s1, _ = _autotune_counters()
+        assert s1 == s0, 'cached mode must never search'
+        assert plan.tuned == [{'block_rows': 64}]  # min(128, rows)
+    finally:
+        kg.clear_plan_cache()
+        autotune.clear_memory()
+
+
+def test_autotune_off_mode_and_lint_ctx_never_time(monkeypatch):
+    from paddle_tpu.ops.kernelgen import autotune
+    calls = []
+
+    def timer(cand):
+        calls.append(cand)
+        return 1.0
+
+    monkeypatch.setenv('PT_AUTOTUNE', '0')
+    assert autotune.choose('row', ('sig',), [{'a': 1}, {'a': 2}],
+                           timer, {'a': 9}, True) == {'a': 9}
+    monkeypatch.setenv('PT_AUTOTUNE', '1')
+    monkeypatch.setenv('PT_CACHE', '0')
+    autotune.clear_memory()
+    assert autotune.choose('row', ('sig',), [{'a': 1}, {'a': 2}],
+                           timer, {'a': 9}, False) == {'a': 9}
+    assert calls == [], 'allow_search=False (lint ctx) must never time'
+    autotune.clear_memory()
+
+
+# ------------------------------ default-on + interpret misconfiguration
+
+def test_enabled_defaults_on_only_for_tpu_backend(monkeypatch):
+    monkeypatch.delenv('PT_KERNELGEN', raising=False)
+    assert not kg.enabled(), 'CPU session: tier defaults OFF'
+    monkeypatch.setattr(jax, 'default_backend', lambda: 'tpu')
+    assert kg.enabled(), 'TPU session: tier defaults ON'
+    monkeypatch.setenv('PT_KERNELGEN', '0')
+    assert not kg.enabled(), 'explicit 0 wins on TPU'
+    monkeypatch.setattr(jax, 'default_backend', lambda: 'cpu')
+    monkeypatch.setenv('PT_KERNELGEN', '1')
+    assert kg.enabled(), 'explicit 1 wins off TPU'
+
+
+def test_interpret_forced_off_without_tpu_raises(monkeypatch):
+    monkeypatch.setenv('PT_KERNELGEN_INTERPRET', '0')
+    with pytest.raises(kg.KernelgenUnsupported) as ei:
+        builder._interpret()
+    msg = str(ei.value)
+    assert 'no TPU' in msg and 'interpret' in msg
 
 
 # ------------------------------------- config tokens and fingerprints
@@ -630,3 +835,28 @@ def test_d016_lint_names_uncovered_sub_op():
     res = lint_program(opt, fetch_names=[y.name])
     d16 = [d for d in res.diagnostics if d.code == 'D016']
     assert d16 and 'made_up_op' in d16[0].message
+
+
+def test_d016_flags_bare_kernel_tier_op():
+    """A softmax the fuse pass could NOT wrap (non-serializable attrs)
+    must be flagged as a bare kernel-tier op, naming the escape."""
+    from paddle_tpu.analysis import lint_program
+    from paddle_tpu.core import passes
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data('x', shape=[8], dtype='float32')
+            s = fluid.layers.softmax(fluid.layers.scale(x, scale=2.0))
+            y = fluid.layers.relu(fluid.layers.scale(s, scale=3.0))
+    for op in main.global_block().ops:
+        if op.type == 'softmax':
+            op.attrs['opaque'] = object()  # blocks _plain_attrs
+    opt, _ = passes.optimize_program(main, (y.name,))
+    assert any(op.type == 'softmax' for op in opt.global_block().ops)
+    res = lint_program(opt, fetch_names=[y.name])
+    d16 = [d for d in res.diagnostics if d.code == 'D016']
+    assert d16, 'bare kernel-tier softmax must raise a D016'
+    assert 'softmax' in d16[0].message
+    assert 'not presented' in d16[0].message
+    assert 'serializable' in d16[0].message  # the named escape reason
+    assert 'plain' in (d16[0].fixit or '')

@@ -31,8 +31,12 @@ fi
 echo "== ci_smoke: opt pipeline op-count + bitwise parity =="
 # the PT_OPT rewriter gate, part 2: the bench transformer program must
 # shrink through the pipeline, and PT_OPT=1 training must be bitwise
-# equal to PT_OPT=0 (losses AND end-of-run param/Adam state)
-timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 python - <<'EOF'
+# equal to PT_OPT=0 (losses AND end-of-run param/Adam state).
+# PT_KERNELGEN=0 pins the kernel tier OFF so this gate isolates the
+# rewriter itself (the strict-kernelgen and autotune gates below own
+# the generated-kernel parity story)
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 PT_KERNELGEN=0 \
+    python - <<'EOF'
 import os
 import sys
 
@@ -264,6 +268,84 @@ if [ "$kg_zoo_rc" -ne 0 ]; then
     echo "ci_smoke: strict-kernelgen gate FAILED (rc=$kg_zoo_rc)"
 fi
 
+echo "== ci_smoke: autotune persistence (search once, reuse forever) =="
+# tile/block autotuner gate (docs/kernels.md): two FRESH processes share
+# one PT_CACHE_DIR.  Run 1 (cold) must pay timed block-size searches
+# (kernelgen.autotune_searches > 0) and persist every choice under
+# <cache>/autotune/.  Between runs the compiled-executable entries are
+# deleted — but NOT the autotune store — so run 2 rebuilds every kernel
+# plan yet must answer every block-size lookup from disk:
+# autotune_searches == 0, autotune_cache_hits > 0, and still zero
+# fallbacks under PT_STRICT_KERNELS=1.
+autotune_cache=$(mktemp -d /tmp/pt_autotune_cache.XXXXXX)
+autotune_gate() {
+    timeout -k 10 600 env JAX_PLATFORMS=cpu PT_KERNELGEN=1 \
+        PT_STRICT_KERNELS=1 PT_AUTOTUNE=1 PT_CACHE=1 \
+        PT_CACHE_DIR="$autotune_cache" AUTOTUNE_PHASE="$1" python - <<'EOF'
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.models import transformer as tr
+
+phase = os.environ['AUTOTUNE_PHASE']
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    with fluid.unique_name.guard():
+        out = tr.build(src_vocab=256, trg_vocab=256, max_len=16,
+                       n_layer=2, n_head=2, d_model=32, d_inner=64,
+                       dropout=0.1, use_flash=False)
+main.set_amp(True)
+exe, scope = fluid.Executor(), fluid.Scope()
+feed = tr.synthetic_batch(np.random.RandomState(0), 2, 16, 256)
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    for _ in range(2):
+        exe.run(main, feed=feed, fetch_list=[out['loss']])
+c = obs.counters()
+searches = c.get('kernelgen.autotune_searches') or 0
+hits = c.get('kernelgen.autotune_cache_hits') or 0
+fallbacks = ((c.get('kernelgen.fallbacks') or 0) +
+             (c.get('kernel.fallbacks') or 0))
+print('ci_smoke: autotune %s run: searches=%d cache_hits=%d fallbacks=%d'
+      % (phase, searches, hits, fallbacks))
+if fallbacks:
+    sys.exit('ci_smoke: %d fallback(s) under PT_STRICT_KERNELS=1 with '
+             'the autotuner on' % fallbacks)
+if phase == 'cold':
+    if searches < 1:
+        sys.exit('ci_smoke: cold run paid no autotune searches — '
+                 'PT_AUTOTUNE=1 but the autotuner never engaged')
+else:
+    if searches != 0:
+        sys.exit('ci_smoke: warm run re-searched %d signature(s) — the '
+                 'persisted autotune choices were not honored' % searches)
+    if hits < 1:
+        sys.exit('ci_smoke: warm run answered no block-size lookups from '
+                 'the persisted autotune store')
+EOF
+}
+autotune_gate cold
+autotune_cold_rc=$?
+if [ "$autotune_cold_rc" -eq 0 ]; then
+    # drop compiled executables but KEEP the autotune store: run 2 must
+    # rebuild every kernel plan and answer every block choice from disk
+    find "$autotune_cache" -mindepth 1 -maxdepth 1 ! -name autotune \
+        -exec rm -rf {} +
+    autotune_gate warm
+    autotune_warm_rc=$?
+else
+    autotune_warm_rc=1
+fi
+autotune_rc=$(( autotune_cold_rc || autotune_warm_rc ))
+if [ "$autotune_rc" -ne 0 ]; then
+    echo "ci_smoke: autotune persistence gate FAILED"
+fi
+rm -rf "$autotune_cache"
+
 echo "== ci_smoke: ruff =="
 # style/bug gate with the committed ruff.toml; the container image may
 # not ship ruff — skip with a notice rather than fail the smoke
@@ -466,8 +548,19 @@ echo "== ci_smoke: bench.py JSON schema + warm-start =="
 # hits > 0 and compile seconds collapsing (core/compile_cache.py).
 smoke_cache=$(mktemp -d /tmp/pt_smoke_cache.XXXXXX)
 trap 'rm -rf "$smoke_cache"' EXIT
-bench_env="JAX_PLATFORMS=cpu BENCH_PROBE_TIMEOUT=60 BENCH_B=2 BENCH_T=16 \
-    BENCH_RESNET_B=1 BENCH_STEPS_PER_LAUNCH=2 PT_CACHE=1 PT_CACHE_DIR=$smoke_cache"
+# BENCH_ALLOW_CPU=1: bench.py hard-exits on a non-TPU backend unless the
+# caller explicitly opts into a CPU smoke (this IS the CPU smoke);
+# PT_STRICT_KERNELS=1: any generated kernel silently degrading to the
+# replay fails the bench run itself, not just the counter check below
+# smoke MODEL dims, not just smoke B/T: the interpret-mode kernelgen
+# tier pays per parameter, so the transformer-base 25M params (and
+# resnet50's) would take minutes per step on CPU
+bench_env="JAX_PLATFORMS=cpu BENCH_PROBE_TIMEOUT=60 BENCH_ALLOW_CPU=1 \
+    BENCH_B=2 BENCH_T=16 BENCH_VOCAB=256 BENCH_LAYERS=2 BENCH_HEADS=2 \
+    BENCH_DMODEL=32 BENCH_DINNER=64 BENCH_RESNET_B=1 \
+    BENCH_RESNET_DEPTH=20 BENCH_RESNET_SET=cifar10 \
+    BENCH_STEPS_PER_LAUNCH=2 \
+    PT_STRICT_KERNELS=1 PT_CACHE=1 PT_CACHE_DIR=$smoke_cache"
 # on failure the last stdout line is bench.py's structured
 # {"error": ..., "stage": ...} tail — echo it so a dead round still
 # leaves a diagnosable artifact in the CI log
@@ -512,7 +605,8 @@ tel_expected = ['platform', 'device_kind', 'retraces', 'retraces_total',
                 'opt_pass_ms', 'opt_ops_fused', 'stall_count',
                 'prefetch_starvation_s', 'fetch_sync_s',
                 'kernel_fallbacks', 'emitter_fallbacks',
-                'kernelgen_ops', 'kernelgen_fallbacks', 'fused_adam_ms',
+                'kernelgen_ops', 'kernelgen_fallbacks',
+                'autotune_searches', 'autotune_cache_hits', 'fused_adam_ms',
                 'host_blocked_s', 'nan_poll_lag_steps',
                 'prefetch_upload_overlap_s', 'forensics_replays',
                 'quarantined_samples']
@@ -563,6 +657,19 @@ if not tel['kernelgen_ops'] > 0:
     sys.exit('ci_smoke: cold bench kernelgen_ops=%r — PT_KERNELGEN=1 is '
              'the bench default but no fused group lowered through a '
              'generated kernel' % tel['kernelgen_ops'])
+# autotuner, bench face (docs/kernels.md): the cold run pays block-size
+# searches; the warm run serves every plan (or every block choice) from
+# the persistent cache and must never re-search.  autotune_cache_hits is
+# NOT asserted here: a fully-warm AOT cache never rebuilds plans, so the
+# dedicated autotune persistence gate above owns the disk-hit assertion.
+if not tel['autotune_searches'] > 0:
+    sys.exit('ci_smoke: cold bench autotune_searches=%r — PT_AUTOTUNE=1 '
+             'is the default but no block-size search ran'
+             % tel['autotune_searches'])
+if rec2['telemetry']['autotune_searches'] != 0:
+    sys.exit('ci_smoke: warm bench re-ran %d autotune search(es) — '
+             'persisted choices (or AOT executables) were not honored'
+             % rec2['telemetry']['autotune_searches'])
 if tel['fused_adam_ms'] is not None and not tel['fused_adam_ms'] > 0:
     sys.exit('ci_smoke: fused_adam_ms=%r — the fused-Adam micro-bench '
              'did not produce a timing' % tel['fused_adam_ms'])
@@ -626,7 +733,7 @@ fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$emit_zoo_rc" -eq 0 ] && \
-    [ "$kg_zoo_rc" -eq 0 ] && \
+    [ "$kg_zoo_rc" -eq 0 ] && [ "$autotune_rc" -eq 0 ] && \
     [ "$soak_rc" -eq 0 ] && \
     [ "$resume_rc" -eq 0 ] && [ "$async_rc" -eq 0 ] && \
     [ "$forensic_rc" -eq 0 ] && [ "$forensic_async_rc" -eq 0 ] && \
