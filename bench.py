@@ -20,13 +20,15 @@ published numbers).
 """
 import json
 import os
-import subprocess
 import sys
-import threading
 import time
-import traceback
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                'tools'))
+import _harness  # noqa: E402 - shared stage/watchdog/probe machinery
+from _harness import PROBE_TIMEOUT_S, probe_backend, stage  # noqa: E402,F401
 
 BASELINE_TOKENS_PER_SEC = 5100.0
 # Fluid-era V100 fp32 ResNet-50 throughput stand-in (BASELINE.json has no
@@ -34,12 +36,6 @@ BASELINE_TOKENS_PER_SEC = 5100.0
 BASELINE_RESNET_IMAGES_PER_SEC = 360.0
 # canonical ResNet-50 224x224 forward cost; training ~= 3x forward
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.1e9
-# BENCH_PROBE_S is the documented knob (default 60s — a healthy PJRT init
-# is seconds, and BENCH_r05 showed a hung one never recovers, so 300s only
-# delayed the CPU fallback); BENCH_PROBE_TIMEOUT kept for back-compat.
-PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_S')
-                      or os.environ.get('BENCH_PROBE_TIMEOUT') or '60')
-
 # peak bf16 FLOP/s by TPU generation (public spec sheets)
 _PEAK_BF16 = {
     'v4': 275e12,
@@ -48,90 +44,10 @@ _PEAK_BF16 = {
     'v6e': 918e12, 'v6 lite': 918e12, 'trillium': 918e12,
 }
 
-_PROBE_CODE = r"""
-import jax, jax.numpy as jnp
-d = jax.devices()
-x = jnp.ones((128, 128), jnp.bfloat16)
-s = float((x @ x).sum())
-assert s == 128 * 128 * 128, s
-print('PROBE_OK', d[0].platform, '|', d[0].device_kind)
-"""
-
-
-def probe_backend(retries=None):
-    """Run a trivial device computation in a subprocess with a timeout.
-    A failed/hung probe is retried once (BENCH_r05 lost a whole round to
-    one transient 300s PJRT init hang).  Returns (platform, device_kind)
-    or (None, reason)."""
-    if retries is None:
-        retries = int(os.environ.get('BENCH_PROBE_RETRIES', '1'))
-    reason = 'probe never ran'
-    for attempt in range(retries + 1):
-        try:
-            r = subprocess.run([sys.executable, '-c', _PROBE_CODE],
-                               capture_output=True, text=True,
-                               timeout=PROBE_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            reason = 'probe timed out after %ds (PJRT init hang)' % \
-                PROBE_TIMEOUT_S
-        else:
-            for line in r.stdout.splitlines():
-                if line.startswith('PROBE_OK'):
-                    _, platform, _, kind = line.split(None, 3)
-                    return platform, kind
-            tail = (r.stderr or r.stdout).strip().splitlines()[-8:]
-            reason = 'probe rc=%d: %s' % (r.returncode, ' | '.join(tail))
-        if attempt < retries:
-            print('BENCH: backend probe failed (%s) — retrying (%d/%d)'
-                  % (reason, attempt + 1, retries), file=sys.stderr)
-    return None, reason
-
-
-# ---------------------------------------------------------------- watchdog
-# A hung in-process compile/launch used to produce a DEAD round: no JSON,
-# no diagnosis.  The watchdog emits a structured {"error": ...} JSON tail
-# naming the last stage the bench entered, dumps every thread's stack to
-# stderr, and exits hard.  BENCH_WATCHDOG_S=0 disables.
-_STAGE = ['startup']
-
-
-def stage(name):
-    _STAGE[0] = name
-    print('BENCH: stage=%s' % name, file=sys.stderr)
-
-
-def _emit_error(kind, detail):
-    print(json.dumps({'error': kind, 'stage': _STAGE[0],
-                      'detail': str(detail)[:2000]}), flush=True)
-
-
-def install_watchdog():
-    budget = float(os.environ.get('BENCH_WATCHDOG_S', '1800'))
-    if budget <= 0:
-        return None
-
-    def _trip():
-        _emit_error('watchdog expired after %.0fs' % budget,
-                    'bench hung in stage %r' % _STAGE[0])
-        try:
-            import faulthandler
-            faulthandler.dump_traceback(file=sys.stderr)
-        except Exception:
-            pass
-        try:
-            # leave a flight-recorder postmortem naming the hung stage
-            from paddle_tpu.observability import flight as _flight
-            _flight.record('bench.watchdog', stage=_STAGE[0],
-                           budget_s=budget)
-            _flight.maybe_dump('watchdog')
-        except Exception:
-            pass
-        os._exit(3)
-
-    t = threading.Timer(budget, _trip)
-    t.daemon = True
-    t.start()
-    return t
+# the probe / watchdog / stage / JSON-tail machinery lives in
+# tools/_harness.py now — one implementation shared with perflab
+# children, fault_soak, serve_soak, pod_soak
+_emit_error = _harness.emit_error
 
 
 def peak_flops(device_kind):
@@ -563,6 +479,28 @@ def main():
         rec['allreduce_gbps'] = round(ar_bw, 1)
     print(json.dumps(rec))
 
+    # feed the perf lab's append-only ledger when asked (PT_PERF_LEDGER):
+    # the SAME record contract as a `perflab run` scenario, so bench rows
+    # diff against blessed baselines with the same counter/timing rules
+    from paddle_tpu.observability import perflab
+    perflab.maybe_ledger(
+        'bench',
+        {'program_op_count_opt': int(opt_ops),
+         'retraces': int(telemetry['retraces']),
+         'kernel_fallbacks': int(telemetry['kernel_fallbacks']),
+         'kernelgen_fallbacks': int(telemetry['kernelgen_fallbacks']),
+         'emitter_fallbacks': int(telemetry['emitter_fallbacks']),
+         'tokens_per_s': round(tps, 1),
+         'mfu': mfu,
+         'host_blocked_s': telemetry.get('host_blocked_s'),
+         'fused_adam_ms': fused_adam_ms,
+         'resnet50_images_per_s':
+             resnet_rec.get('resnet50_images_per_sec'),
+         'batch': B, 'seq': T},
+        config={'steps_per_launch': K, 'vocab': vocab,
+                'layers': n_layer, 'd_model': d_model},
+        fallback=fallback_reason)
+
 
 def _tiny_warmup(fluid, vocab):
     """One 2-layer micro train step end-to-end: exercises the same lowering
@@ -585,18 +523,6 @@ def _tiny_warmup(fluid, vocab):
 
 
 if __name__ == '__main__':
-    _wd = install_watchdog()
-    try:
-        rc = main()
-    except SystemExit:
-        raise
-    except BaseException as e:  # noqa: BLE001 - structured JSON death
-        # a crashed bench still leaves a diagnosable artifact: the last
-        # line is {"error": ..., "stage": ...} instead of a bare stack
-        traceback.print_exc()
-        _emit_error(type(e).__name__, e)
-        sys.exit(1)
-    finally:
-        if _wd is not None:
-            _wd.cancel()
-    sys.exit(rc)
+    # a crashed bench still leaves a diagnosable artifact: the last
+    # line is {"error": ..., "stage": ...} instead of a bare stack
+    _harness.main_guard(main, flight_tag='bench.watchdog')

@@ -163,6 +163,111 @@ SCHEMA = {
     ),
 }
 
+# ------------------------------------------- perf-lab record sections
+# One ``perflab.<scenario>`` section per performance-lab scenario
+# (observability/perflab.py validates every ledger record against its
+# section).  These use a different spec vocabulary from the telemetry
+# sections above — they describe RECORD metrics and how `perflab
+# compare` treats them, not how to read the live registry:
+#
+#   ('counter', 'lower'|'higher')      deterministic integer.  Exact,
+#       zero tolerance: any move in the worse direction (away from the
+#       declared better direction) is a regression.  CI-enforceable on
+#       CPU — op counts, fallbacks and retraces don't depend on clock
+#       noise.
+#   ('timing', 'lower'|'higher', unit) noise-bounded float (or null
+#       when unmeasurable, e.g. MFU off-TPU).  Best-of-K with the raw
+#       samples recorded in the record's ``spread`` block; compared
+#       only when baseline and candidate share a backend, within a
+#       per-metric relative threshold widened by the observed spread.
+#   ('info', )                         descriptive context (shapes,
+#       request counts).  Never compared.
+SCHEMA.update({
+    'perflab.train_transformer': (
+        ('program_op_count_opt', ('counter', 'lower')),
+        ('compiles_after_warmup', ('counter', 'lower')),
+        ('retraces', ('counter', 'lower')),
+        ('kernel_fallbacks', ('counter', 'lower')),
+        ('kernelgen_fallbacks', ('counter', 'lower')),
+        ('emitter_fallbacks', ('counter', 'lower')),
+        ('tokens_per_s', ('timing', 'higher', 'tokens/s')),
+        ('mfu', ('timing', 'higher', 'ratio')),
+        ('host_blocked_s', ('timing', 'lower', 's')),
+        ('params_m', ('info',)),
+        ('batch', ('info',)),
+        ('seq', ('info',)),
+        ('steps_per_launch', ('info',)),
+    ),
+    'perflab.train_resnet': (
+        ('compiles_after_warmup', ('counter', 'lower')),
+        ('retraces', ('counter', 'lower')),
+        ('kernel_fallbacks', ('counter', 'lower')),
+        ('emitter_fallbacks', ('counter', 'lower')),
+        ('images_per_s', ('timing', 'higher', 'img/s')),
+        ('mfu', ('timing', 'higher', 'ratio')),
+        ('batch', ('info',)),
+        ('depth', ('info',)),
+    ),
+    'perflab.decode_stream': (
+        ('compiles_after_warmup', ('counter', 'lower')),
+        ('deadlocks', ('counter', 'lower')),
+        ('kv_slots_leaked', ('counter', 'lower')),
+        ('streams_failed', ('counter', 'lower')),
+        ('tokens_per_s_per_chip', ('timing', 'higher', 'tokens/s')),
+        ('ttft_p99_ms', ('timing', 'lower', 'ms')),
+        ('itl_p99_ms', ('timing', 'lower', 'ms')),
+        ('requests', ('info',)),
+        ('streams_ok', ('info',)),
+    ),
+    'perflab.pod_parallel': (
+        ('workers_completed', ('counter', 'higher')),
+        ('worker_failures', ('counter', 'lower')),
+        ('allreduce_gbps', ('timing', 'higher', 'GB/s')),
+        ('steps_per_s_1worker', ('timing', 'higher', 'steps/s')),
+        ('scaling_2worker_x', ('timing', 'higher', 'x')),
+        ('devices', ('info',)),
+    ),
+    'perflab.fused_adam_micro': (
+        ('kernelgen_ops', ('counter', 'higher')),
+        ('kernelgen_fallbacks', ('counter', 'lower')),
+        ('retraces', ('counter', 'lower')),
+        ('fused_adam_ms', ('timing', 'lower', 'ms')),
+        ('params', ('info',)),
+    ),
+    # ledger bridges: bench.py / serve_soak.py / pod_soak.py emit their
+    # existing telemetry through the shared scenario-record writer
+    # (PT_PERF_LEDGER=<path>) so all three feed the same PERF_HISTORY
+    'perflab.bench': (
+        ('program_op_count_opt', ('counter', 'lower')),
+        ('retraces', ('counter', 'lower')),
+        ('kernel_fallbacks', ('counter', 'lower')),
+        ('kernelgen_fallbacks', ('counter', 'lower')),
+        ('emitter_fallbacks', ('counter', 'lower')),
+        ('tokens_per_s', ('timing', 'higher', 'tokens/s')),
+        ('mfu', ('timing', 'higher', 'ratio')),
+        ('host_blocked_s', ('timing', 'lower', 's')),
+        ('fused_adam_ms', ('timing', 'lower', 'ms')),
+        ('resnet50_images_per_s', ('timing', 'higher', 'img/s')),
+        ('batch', ('info',)),
+        ('seq', ('info',)),
+    ),
+    'perflab.serve_soak': (
+        ('deadlocks', ('counter', 'lower')),
+        ('no_reply', ('counter', 'lower')),
+        ('p99_ms', ('timing', 'lower', 'ms')),
+        ('ttft_p99_ms', ('timing', 'lower', 'ms')),
+        ('itl_p99_ms', ('timing', 'lower', 'ms')),
+        ('scenario', ('info',)),
+        ('admitted', ('info',)),
+    ),
+    'perflab.pod_soak': (
+        ('failures', ('counter', 'lower')),
+        ('segments', ('info',)),
+        ('rollbacks', ('info',)),
+        ('manifests', ('info',)),
+    ),
+})
+
 
 def schema_keys(section):
     return [k for k, _ in SCHEMA[section]]

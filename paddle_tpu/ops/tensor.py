@@ -217,9 +217,12 @@ def _fill_value(value, dtype):
     scalar (program serialization hands these back) or an out-of-range
     Python int would hit jax's x32 warn-and-truncate inside the trace.
     Narrow HERE with explicit C-style wraparound so the truncation is
-    ours — same numerics, silent under warnings-as-error."""
+    ours — same numerics, silent under warnings-as-error.  numpy >= 1.24
+    raises its own RuntimeWarning on an overflowing astype, so the
+    wraparound cast runs under errstate suppression."""
     try:
-        return np.asarray(value).astype(dtype)
+        with np.errstate(over='ignore', invalid='ignore'):
+            return np.asarray(value).astype(dtype)
     except (OverflowError, TypeError, ValueError):
         return value
 

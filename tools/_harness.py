@@ -1,0 +1,157 @@
+"""Shared bench/soak process harness: stage tracking, the structured
+{"error", "stage"} JSON tail, the hang watchdog, and the subprocess
+backend probe.
+
+One implementation, one contract, five consumers (bench.py, perflab
+children, fault_soak, serve_soak, pod_soak): whatever kills the process
+— an exception, a hang, a hung PJRT init — the LAST stdout line is
+
+    {"error": <kind>, "stage": <last stage entered>, "detail": ...}
+
+so a dead round is still a diagnosable artifact instead of a bare
+stack (or nothing).  Stdlib-only on purpose: bench.py must be able to
+import this BEFORE importing jax/paddle_tpu, because the whole point of
+the subprocess probe is to never init the device runtime in-process
+until a child proved it responds.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import traceback
+
+# BENCH_PROBE_S is the documented knob (default 60s — a healthy PJRT
+# init is seconds, and BENCH_r05 showed a hung one never recovers, so
+# 300s only delayed the CPU fallback); BENCH_PROBE_TIMEOUT kept for
+# back-compat.
+PROBE_TIMEOUT_S = int(os.environ.get('BENCH_PROBE_S')
+                      or os.environ.get('BENCH_PROBE_TIMEOUT') or '60')
+
+_PROBE_CODE = r"""
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((128, 128), jnp.bfloat16)
+s = float((x @ x).sum())
+assert s == 128 * 128 * 128, s
+print('PROBE_OK', d[0].platform, '|', d[0].device_kind)
+"""
+
+_TOOL = ['BENCH']
+_STAGE = ['startup']
+
+
+def set_tool(name):
+    """Stage-line prefix, e.g. set_tool('PERFLAB') -> 'PERFLAB: stage=x'."""
+    _TOOL[0] = name
+
+
+def current_stage():
+    return _STAGE[0]
+
+
+def stage(name):
+    _STAGE[0] = name
+    print('%s: stage=%s' % (_TOOL[0], name), file=sys.stderr)
+
+
+def emit_error(kind, detail, **extra):
+    """The structured JSON death tail.  Extra keys (e.g. scenario=...)
+    ride along so supervisors can attribute the failure."""
+    rec = {'error': kind, 'stage': _STAGE[0], 'detail': str(detail)[:2000]}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def install_watchdog(default_s=1800.0, env='BENCH_WATCHDOG_S',
+                     flight_tag=None, **extra):
+    """A hung in-process compile/launch used to produce a DEAD round: no
+    JSON, no diagnosis.  The watchdog emits the structured JSON tail
+    naming the last stage entered, dumps every thread's stack to stderr,
+    leaves a flight-recorder postmortem, and exits hard.  <env>=0
+    disables.  Returns the timer (cancel it on clean exit) or None."""
+    budget = float(os.environ.get(env, str(default_s)))
+    if budget <= 0:
+        return None
+
+    def _trip():
+        emit_error('watchdog expired after %.0fs' % budget,
+                   'hung in stage %r' % _STAGE[0], **extra)
+        try:
+            import faulthandler
+            faulthandler.dump_traceback(file=sys.stderr)
+        except Exception:
+            pass
+        try:
+            # a flight postmortem naming the hung stage (only if the
+            # observability plane was imported — never import jax here)
+            if 'paddle_tpu.observability.flight' in sys.modules:
+                _flight = sys.modules['paddle_tpu.observability.flight']
+                _flight.record(flight_tag or 'harness.watchdog',
+                               stage=_STAGE[0], budget_s=budget)
+                _flight.maybe_dump('watchdog')
+        except Exception:
+            pass
+        os._exit(3)
+
+    t = threading.Timer(budget, _trip)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def probe_backend(retries=None, timeout_s=None):
+    """Run a trivial device computation in a subprocess with a timeout.
+    A failed/hung probe is retried once (BENCH_r05 lost a whole round to
+    one transient 300s PJRT init hang).  Returns (platform, device_kind)
+    or (None, reason)."""
+    if retries is None:
+        retries = int(os.environ.get('BENCH_PROBE_RETRIES', '1'))
+    if timeout_s is None:
+        timeout_s = PROBE_TIMEOUT_S
+    reason = 'probe never ran'
+    for attempt in range(retries + 1):
+        try:
+            r = subprocess.run([sys.executable, '-c', _PROBE_CODE],
+                               capture_output=True, text=True,
+                               timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            reason = 'probe timed out after %ds (PJRT init hang)' % \
+                timeout_s
+        else:
+            for line in r.stdout.splitlines():
+                if line.startswith('PROBE_OK'):
+                    _, platform, _, kind = line.split(None, 3)
+                    return platform, kind
+            tail = (r.stderr or r.stdout).strip().splitlines()[-8:]
+            reason = 'probe rc=%d: %s' % (r.returncode, ' | '.join(tail))
+        if attempt < retries:
+            print('%s: backend probe failed (%s) — retrying (%d/%d)'
+                  % (_TOOL[0], reason, attempt + 1, retries),
+                  file=sys.stderr)
+    return None, reason
+
+
+def main_guard(main, watchdog=True, watchdog_default_s=1800.0,
+               watchdog_env='BENCH_WATCHDOG_S', flight_tag=None, **extra):
+    """Run ``main()`` under the watchdog with the JSON-tail contract:
+    an uncaught exception prints its traceback to stderr and the
+    structured {"error", "stage"} line to stdout, then exits 1.
+    SystemExit passes through untouched (soak SLO failures keep their
+    messages and codes).  ``extra`` keys (e.g. scenario=...) ride along
+    in the JSON tail.  Returns main()'s return code via sys.exit."""
+    wd = install_watchdog(watchdog_default_s, env=watchdog_env,
+                          flight_tag=flight_tag,
+                          **extra) if watchdog else None
+    try:
+        rc = main()
+    except SystemExit:
+        raise
+    except BaseException as e:  # noqa: BLE001 - structured JSON death
+        traceback.print_exc()
+        emit_error(type(e).__name__, e, **extra)
+        sys.exit(1)
+    finally:
+        if wd is not None:
+            wd.cancel()
+    sys.exit(rc)

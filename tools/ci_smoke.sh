@@ -727,6 +727,36 @@ print('ci_smoke: warm start ok (cold compile_s=%.2f -> warm %.2f, '
 EOF
 schema_rc=$?
 
+echo "== ci_smoke: perf lab — scenario matrix, ledger, regression gate =="
+# the full matrix at the SAME smoke geometry as the bench gate, into a
+# throwaway ledger: every scenario must land a schema-valid record with
+# non-null provenance (`check`), and `compare --fail-on regression`
+# must come back green against the committed smoke baseline
+# (PERF_BASELINE.json, blessed with this exact env — counters are
+# zero-tolerance; timings ride the baseline's wide smoke tolerance).
+# JAX_PLATFORMS=cpu marks the records a DELIBERATE cpu run (fallback
+# null), so the committed cpu baseline compares instead of refusing.
+perflab_ledger="$smoke_cache/perflab_ledger.jsonl"
+perflab_env="JAX_PLATFORMS=cpu PT_KERNELGEN=1 PT_STRICT_KERNELS=1 \
+    PT_CACHE=1 PT_CACHE_DIR=$smoke_cache \
+    BENCH_B=2 BENCH_T=16 BENCH_VOCAB=256 BENCH_LAYERS=2 BENCH_HEADS=2 \
+    BENCH_DMODEL=32 BENCH_DINNER=64 BENCH_RESNET_B=1 \
+    BENCH_RESNET_DEPTH=20 BENCH_RESNET_SET=cifar10 \
+    BENCH_STEPS_PER_LAUNCH=2 \
+    PERFLAB_BEST_OF=2 PERFLAB_DECODE_REQUESTS=6 PERFLAB_POD_STEPS=4 \
+    PERFLAB_RESNET_STEPS=2 PERFLAB_ADAM_STEPS=5 PERFLAB_LAUNCHES=2"
+timeout -k 10 1800 env $perflab_env python tools/perflab.py run \
+    --ledger "$perflab_ledger" --budget-s 420 \
+    && env $perflab_env python tools/perflab.py check \
+        --ledger "$perflab_ledger" \
+    && env $perflab_env python tools/perflab.py compare \
+        --ledger "$perflab_ledger" --baseline PERF_BASELINE.json \
+        --fail-on regression
+perflab_rc=$?
+if [ "$perflab_rc" -ne 0 ]; then
+    echo "ci_smoke: perflab gate FAILED (rc=$perflab_rc)"
+fi
+
 if [ "$t1_rc" -ne 0 ]; then
     echo "ci_smoke: tier-1 tests FAILED (rc=$t1_rc)"
 fi
@@ -738,4 +768,5 @@ fi
     [ "$resume_rc" -eq 0 ] && [ "$async_rc" -eq 0 ] && \
     [ "$forensic_rc" -eq 0 ] && [ "$forensic_async_rc" -eq 0 ] && \
     [ "$pod_rc" -eq 0 ] && \
-    [ "$serve_rc" -eq 0 ] && [ "$decode_rc" -eq 0 ]
+    [ "$serve_rc" -eq 0 ] && [ "$decode_rc" -eq 0 ] && \
+    [ "$perflab_rc" -eq 0 ]

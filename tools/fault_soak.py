@@ -36,6 +36,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _harness  # noqa: E402 - shared stage/watchdog/JSON-tail contract
 
 BATCH = 8
 
@@ -138,6 +141,7 @@ def main():
 
     _flight.install()   # an uncaught crash still leaves a postmortem
 
+    _harness.stage('build')
     main_prog, startup, loss = build_model(fluid)
 
     exe = fluid.Executor(check_nan=True)
@@ -194,6 +198,7 @@ def main():
         if ck.maybe_save(0, step_id):
             policy.note_checkpoint(step_id)
 
+    _harness.stage('train')
     with fluid.scope_guard(scope):
         if meta is None:
             exe.run(startup)
@@ -263,6 +268,7 @@ def main():
                 flush_pending()
                 saved(step - 1)
         ck.wait()
+    _harness.stage('audit')
     c = obs.counters()
     retraces_after_recovery = 0 if retrace_mark is None else \
         int(c.get('executor.retraces') or 0) - retrace_mark
@@ -361,4 +367,6 @@ def main():
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    _harness.set_tool('FAULT_SOAK')
+    _harness.main_guard(main, watchdog_env='PT_SOAK_WATCHDOG_S',
+                        flight_tag='fault_soak.watchdog')

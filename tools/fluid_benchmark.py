@@ -1,149 +1,15 @@
-"""Model benchmark harness.
-
-Parity: reference benchmark/fluid/fluid_benchmark.py + args.py — same
-CLI shape (--model/--batch_size/--iterations/--skip_batch_num/
---learning_rate), same model set (mnist, resnet, vgg, se_resnext,
-machine_translation, stacked_dynamic_lstm), synthetic data, prints
-per-model throughput.  One whole-step XLA executable per model; the
-timed loop runs async with a single sync at the end (steady-state
-training measures the chip, not per-step RTT).
+"""Thin alias: the reference model-matrix benchmark moved into the perf
+lab (`python tools/perflab.py models ...`; implementation in
+tools/_probes.py).  This shim keeps the old invocation working:
 
     python tools/fluid_benchmark.py --model resnet --batch_size 64
 """
-import argparse
-import json
 import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-
-BENCHMARK_MODELS = ['mnist', 'resnet', 'vgg', 'se_resnext',
-                    'machine_translation', 'stacked_dynamic_lstm']
-
-
-def parse_args():
-    p = argparse.ArgumentParser('paddle_tpu model benchmarks.')
-    p.add_argument('--model', type=str, choices=BENCHMARK_MODELS,
-                   default='resnet')
-    p.add_argument('--batch_size', type=int, default=32)
-    p.add_argument('--learning_rate', type=float, default=None,
-                   help='override each model\'s default lr/schedule scale')
-    p.add_argument('--skip_batch_num', type=int, default=5,
-                   help='warmup minibatches excluded from timing')
-    p.add_argument('--iterations', type=int, default=30,
-                   help='timed minibatches')
-    p.add_argument('--seq_len', type=int, default=256,
-                   help='sequence length (translation / lstm models)')
-    p.add_argument('--class_dim', type=int, default=1000)
-    p.add_argument('--image_size', type=int, default=224)
-    p.add_argument('--device', type=str, default='TPU',
-                   choices=['CPU', 'TPU'],
-                   help='CPU forces the host backend')
-    return p.parse_args()
-
-
-def _build(args):
-    import paddle_tpu as fluid
-    rng = np.random.RandomState(0)
-    B = args.batch_size
-
-    def lr_kw(default):
-        return {'lr': args.learning_rate
-                if args.learning_rate is not None else default}
-
-    if args.model == 'mnist':
-        from paddle_tpu.models import mnist as m
-        out = m.build(**lr_kw(0.001))
-        feed = {'pixel': rng.rand(B, 1, 28, 28).astype('float32'),
-                'label': rng.randint(0, 10, (B, 1)).astype('int64')}
-        unit, per_step = 'images/s', B
-    elif args.model in ('resnet', 'vgg', 'se_resnext'):
-        shape = (3, args.image_size, args.image_size)
-        if args.model == 'resnet':
-            from paddle_tpu.models import resnet as m
-            out = m.build(data_shape=shape, class_dim=args.class_dim,
-                          depth=50, **lr_kw(0.1))
-        elif args.model == 'vgg':
-            from paddle_tpu.models import vgg as m
-            out = m.build(data_shape=shape, class_dim=args.class_dim,
-                          **lr_kw(1e-3))
-        else:
-            from paddle_tpu.models import se_resnext as m
-            out = m.build(data_shape=shape, class_dim=args.class_dim,
-                          **lr_kw(0.1))
-        feed = {'data': rng.rand(B, *shape).astype('float32'),
-                'label': rng.randint(0, args.class_dim,
-                                     (B, 1)).astype('int64')}
-        unit, per_step = 'images/s', B
-    elif args.model == 'machine_translation':
-        from paddle_tpu.models import transformer as tr
-        T = args.seq_len
-        out = tr.build(src_vocab=32000, trg_vocab=32000, max_len=T,
-                       n_layer=6, n_head=8, d_model=512, d_inner=2048,
-                       dropout=0.0, use_flash=True,
-                       **lr_kw(2.0))   # lr scales the noam schedule here
-        feed = tr.synthetic_batch(rng, B, T)
-        unit, per_step = 'tokens/s', B * T
-    else:  # stacked_dynamic_lstm
-        from paddle_tpu.models import stacked_lstm as m
-        from paddle_tpu.core.lod import create_lod_tensor
-        out = m.build(**lr_kw(0.002))
-        T = min(args.seq_len, 128)
-        rows = [rng.randint(2, 5147, (T, 1)).astype('int64')
-                for _ in range(B)]
-        feed = {'words': create_lod_tensor(rows),
-                'label': rng.randint(0, 2, (B, 1)).astype('int64')}
-        unit, per_step = 'words/s', B * T
-    return out, feed, unit, per_step
-
-
-def main():
-    args = parse_args()
-    if args.device == 'CPU':
-        import jax
-        jax.config.update('jax_platforms', 'cpu')
-    import jax
-    import paddle_tpu as fluid
-
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        with fluid.unique_name.guard():
-            out, feed, unit, per_step = _build(args)
-    if args.device != 'CPU':
-        main_prog.set_amp(True)
-
-    exe = fluid.Executor()
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        feed = {k: (v if hasattr(v, 'padded') else jax.device_put(v))
-                for k, v in feed.items()}
-        t0 = time.perf_counter()
-        for _ in range(max(1, args.skip_batch_num)):
-            loss, = exe.run(main_prog, feed=feed,
-                            fetch_list=[out['loss']])
-        np.asarray(loss)
-        print('%s: compile+warmup %.1fs'
-              % (args.model, time.perf_counter() - t0), file=sys.stderr)
-        t0 = time.perf_counter()
-        for _ in range(args.iterations):
-            loss, = exe.run(main_prog, feed=feed,
-                            fetch_list=[out['loss']],
-                            return_numpy=False)
-        final = float(np.asarray(loss).reshape(()))
-        dt = time.perf_counter() - t0
-    tput = args.iterations * per_step / dt
-    print(json.dumps({
-        'model': args.model, 'batch_size': args.batch_size,
-        'iterations': args.iterations, 'throughput': round(tput, 1),
-        'unit': unit, 'final_loss': round(final, 4),
-        'backend': jax.devices()[0].device_kind,
-    }))
-
+import _probes  # noqa: E402
 
 if __name__ == '__main__':
-    sys.exit(main())
+    sys.exit(_probes.models_main())

@@ -52,6 +52,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _harness  # noqa: E402 - shared stage/watchdog/JSON-tail contract
 
 
 # --------------------------------------------------------------- worker
@@ -224,6 +227,7 @@ def supervisor_main(args):
             print('pod_soak: FAIL %s' % msg, file=sys.stderr)
 
     # ---- reference: 1 uninterrupted host, same stream --------------
+    _harness.stage('reference')
     ref_args = argparse.Namespace(**vars(args))
     ref_args.ckpt = os.path.join(args.dir, 'ref_ckpt')
     p = _spawn(ref_args, host=0, hosts=1,
@@ -246,6 +250,7 @@ def supervisor_main(args):
                  wedge_host=None):
         wave = Wave(name)
         waves.append(wave)
+        _harness.stage('wave_%s' % name)
         health_dir = os.path.join(args.dir, 'health_%s' % name)
         delay = args.step_delay if step_delay is None else step_delay
         procs = {}
@@ -354,6 +359,7 @@ def supervisor_main(args):
                   'cross the roster change' % h)
 
     # ---- cross-cutting asserts -------------------------------------
+    _harness.stage('audit')
     # bitwise resume parity: EVERY segment (all waves, all hosts) must
     # prefix-match the uninterrupted reference from its start step
     for seg in segments[1:]:
@@ -389,6 +395,13 @@ def supervisor_main(args):
         'failures': fails,
     }
     print(json.dumps(verdict))
+    from paddle_tpu.observability import perflab
+    perflab.maybe_ledger(
+        'pod_soak',
+        {'failures': len(fails),
+         'segments': verdict['segments'],
+         'rollbacks': rollbacks,
+         'manifests': verdict['manifests']})
     return 0 if not fails else 1
 
 
@@ -430,4 +443,6 @@ def main():
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    _harness.set_tool('POD_SOAK')
+    _harness.main_guard(main, watchdog_env='PT_SOAK_WATCHDOG_S',
+                        flight_tag='pod_soak.watchdog')

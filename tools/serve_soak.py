@@ -69,6 +69,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import _harness  # noqa: E402 - shared stage/watchdog/JSON-tail contract
 
 
 def build_predictor_backend(tmpdir):
@@ -131,6 +134,7 @@ def run_decode_scenario(args):
     from paddle_tpu.serving.generation.decode import random_weights
 
     _flight.install()
+    _harness.stage('decode_setup')
     cfg = dict(vocab=128, d_model=32, n_layer=2, n_head=4, n_kv_head=2,
                d_ffn=64, theta=10000.0, max_len=32)
     w = random_weights(cfg, seed=0)
@@ -155,6 +159,7 @@ def run_decode_scenario(args):
     rt.warmup(steps=K)
     compiles0 = obs.counters().get('generation.compiles') or 0
 
+    _harness.stage('decode_traffic')
     streams, cancellers = [], []
     overlong = 0
     period = 1.0 / args.qps if args.qps > 0 else 0.0
@@ -191,6 +196,7 @@ def run_decode_scenario(args):
         t.join(timeout=30.0)
     engine.stop()
 
+    _harness.stage('decode_audit')
     statuses, no_reply = {}, 0
     for s in streams:
         if not s.done():
@@ -219,6 +225,14 @@ def run_decode_scenario(args):
     }
     rec.update(tel)
     print(json.dumps(rec))
+    from paddle_tpu.observability import perflab
+    perflab.maybe_ledger(
+        'serve_soak',
+        {'deadlocks': int(rec['deadlocks']), 'no_reply': no_reply,
+         'p99_ms': rec.get('p99_ms'),
+         'ttft_p99_ms': rec.get('ttft_p99_ms'),
+         'itl_p99_ms': rec.get('itl_p99_ms'),
+         'scenario': 'decode', 'admitted': rec.get('admitted')})
 
     if args.assert_slo:
         if no_reply:
@@ -319,6 +333,7 @@ def main():
 
     _flight.install()   # an uncaught crash still leaves a postmortem
 
+    _harness.stage('setup')
     import tempfile
     tmpdir = tempfile.mkdtemp(prefix='pt_serve_soak.')
     backend = (build_stub_backend(args.stub_latency_ms / 1e3) if args.stub
@@ -374,6 +389,7 @@ def main():
         t.start()
 
     # open loop: fixed-rate fire-and-remember
+    _harness.stage('traffic')
     period = 1.0 / args.qps if args.qps > 0 else 0.0
     for i in range(args.requests):
         if _faults.active('sigterm') and _faults.fire('sigterm', step=i):
@@ -415,6 +431,7 @@ def main():
                      'not start a metrics server (is PT_OBS=0?)')
         mid_scrape_ok = 'serving_admitted_total' in prom_values(scrape())
 
+    _harness.stage('drain')
     drained = engine.drain()
     stop_clients.set()
     for t in clients:
@@ -452,6 +469,14 @@ def main():
     }
     rec.update(tel)
     print(json.dumps(rec))
+    from paddle_tpu.observability import perflab
+    perflab.maybe_ledger(
+        'serve_soak',
+        {'deadlocks': int(rec['deadlocks']), 'no_reply': no_reply,
+         'p99_ms': p99,
+         'ttft_p99_ms': rec.get('ttft_p99_ms'),
+         'itl_p99_ms': rec.get('itl_p99_ms'),
+         'scenario': 'oneshot', 'admitted': admitted})
 
     if args.assert_slo:
         if no_reply:
@@ -582,4 +607,6 @@ def main():
 
 
 if __name__ == '__main__':
-    sys.exit(main())
+    _harness.set_tool('SERVE_SOAK')
+    _harness.main_guard(main, watchdog_env='PT_SOAK_WATCHDOG_S',
+                        flight_tag='serve_soak.watchdog')
