@@ -140,6 +140,9 @@ SCHEMA = {
         ('itl_p50_ms', ('quantile', 'serving.itl_ms', 0.50)),
         ('itl_p99_ms', ('quantile', 'serving.itl_ms', 0.99)),
         ('kv_slots_in_use', ('int', 'generation.kv_slots_in_use')),
+        ('kv_pages_in_use', ('int', 'generation.kv_pages_in_use')),
+        ('kv_bytes_reserved', ('int', 'generation.kv_bytes_reserved')),
+        ('kv_bytes_live', ('int', 'generation.kv_bytes_live')),
         ('counters', ('block_prefix', ('serving.', 'faults.',
                                        'generation.'),
                       ('bucketer.bucket_count',))),
@@ -212,7 +215,10 @@ SCHEMA.update({
         ('compiles_after_warmup', ('counter', 'lower')),
         ('deadlocks', ('counter', 'lower')),
         ('kv_slots_leaked', ('counter', 'lower')),
+        ('kv_pages_leaked', ('counter', 'lower')),
         ('streams_failed', ('counter', 'lower')),
+        ('streams_at_slo', ('counter', 'higher')),
+        ('density_x_vs_dense', ('counter', 'higher')),
         ('tokens_per_s_per_chip', ('timing', 'higher', 'tokens/s')),
         ('ttft_p99_ms', ('timing', 'lower', 'ms')),
         ('itl_p99_ms', ('timing', 'lower', 'ms')),
@@ -259,6 +265,15 @@ SCHEMA.update({
         ('itl_p99_ms', ('timing', 'lower', 'ms')),
         ('scenario', ('info',)),
         ('admitted', ('info',)),
+    ),
+    'perflab.decode_capacity': (
+        ('streams_at_slo', ('counter', 'higher')),
+        ('kv_pages_leaked', ('counter', 'lower')),
+        ('density_x_vs_dense', ('counter', 'higher')),
+        ('capacity_floor', ('info',)),
+        ('kv_budget_bytes', ('info',)),
+        ('page_len', ('info',)),
+        ('kv_quant', ('info',)),
     ),
     'perflab.pod_soak': (
         ('failures', ('counter', 'lower')),

@@ -3,20 +3,26 @@
 The generation subsystem turns the ServingEngine into a streaming
 decode server (docs/generation.md):
 
-  * `kv_cache` — slotted per-request KV cache: preallocated
-    ``[slots, layers, kv_heads, max_len, head_dim]`` pages, free-list
-    slot allocation, per-slot length masks.
-  * `decode` — the fused prefill/decode executables: K decode tokens
-    launch as ONE `lax.scan` with the cache as donated carry (no host
-    round-trips inside the window), chunked/ring prefill, AOT-compiled
-    and persisted through the compile-cache disk tier.
+  * `kv_cache` — PAGED KV storage: one shared page pool
+    ``[pages, layers, kv_heads, page_len, head_dim]`` plus per-slot
+    block tables, refcounted free-list page allocation (`PagePool`),
+    optional int8 quantization (``PT_KV_QUANT``) and a fingerprinted
+    shared-prefix page cache (`PrefixCache`, ``PT_PREFIX_CACHE``) — a
+    stream's footprint is ceil(len/page_len) pages, not max_len rows.
+  * `decode` — the fused prefill/decode/verify executables: K decode
+    tokens launch as ONE `lax.scan` with the page pools as donated
+    carry (no host round-trips inside the window); block tables are
+    per-launch DATA, so one warm executable serves every page
+    assignment; chunked/ring prefill; speculative verify windows;
+    AOT-compiled and persisted through the compile-cache disk tier.
   * `sampling` — greedy / temperature / top-k draws keyed by
     ``(request seed, absolute position)`` only, so fused and sequential
     decode sample bitwise-identical streams (ops/sampling.py).
   * `scheduler` — mixed prefill+decode continuous batching on the
     PR-8 engine: prompts prefill one chunk per round, interleaved with
-    full-width decode windows, requests migrating prefill→decode slot
-    in place.
+    full-width decode (or speculative draft+verify) windows; page-pool
+    shortage is admission BACKPRESSURE, never truncation, and a stream
+    that cannot grow retires with a terminal ``kv_oom`` reply.
   * `streaming` — per-token delivery through the engine reply path
     with TTFT/ITL SLOs and EOS / max-token / cancel termination, all
     resolving the terminal-reply invariant exactly once.
@@ -29,12 +35,16 @@ decode server (docs/generation.md):
         ...
     result = stream.result()          # ServeResult, reason='eos'/...
 """
-from .kv_cache import CacheConfig, SlotAllocator, init_state  # noqa
-from .decode import DecodeRuntime, dense_reference, weight_names  # noqa
-from .sampling import SamplingParams  # noqa
+from .kv_cache import (CacheConfig, PagePool, PrefixCache,  # noqa
+                       SlotAllocator, default_page_len, init_state)
+from .decode import (DecodeRuntime, dense_reference,  # noqa
+                     random_weights, weight_names)
+from .sampling import SamplingParams, draft_ngram  # noqa
 from .streaming import TokenStream  # noqa
 from .scheduler import GenerationConfig, GenerationEngine  # noqa
 
-__all__ = ['CacheConfig', 'SlotAllocator', 'init_state', 'DecodeRuntime',
-           'dense_reference', 'weight_names', 'SamplingParams',
-           'TokenStream', 'GenerationConfig', 'GenerationEngine']
+__all__ = ['CacheConfig', 'PagePool', 'PrefixCache', 'SlotAllocator',
+           'default_page_len', 'init_state', 'DecodeRuntime',
+           'dense_reference', 'random_weights', 'weight_names',
+           'SamplingParams', 'draft_ngram', 'TokenStream',
+           'GenerationConfig', 'GenerationEngine']

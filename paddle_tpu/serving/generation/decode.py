@@ -1,37 +1,56 @@
-"""Fused prefill/decode executables over the slotted KV cache.
+"""Fused prefill/decode executables over the PAGED KV pool.
 
-The decode hot loop is the few-large-fused-primitives shape: one AOT
+The decode hot loop keeps the few-large-fused-primitives shape: one AOT
 executable advances ALL cache slots K tokens as a single `lax.scan`
-with the cache pages as DONATED carry — no per-token Python dispatch,
-no host round-trips inside the window.  Inactive slots ride along under
-a mask (their writes land at their own row's next free position, which
-is overwritten before it is ever attended), so the executable signature
-never depends on which requests are live: one warm executable serves
-every batch composition forever (zero per-token retraces).
+with the page pools as DONATED carry — no per-token Python dispatch, no
+host round-trips inside the window.  K/V now live in a shared page pool
+(kv_cache.py); every read and write goes through a per-slot BLOCK TABLE
+passed as a plain ``[slots, max_pages]`` int32 argument.  Block tables
+are DATA, never part of an executable signature: one warm executable
+serves every batch composition and every page assignment forever (the
+``generation.compiles == 2`` pin survives paging untouched).
 
-Prefill is chunked: each chunk writes its K/V into the request's slot at
-its absolute offset and attends against the whole cache row with the
-positional mask ``kpos <= qpos`` (ops/attention.cached_attention), so a
-long prompt advances one bounded-cost chunk per scheduler round and
-never stalls the decode batch.  With a mesh carrying a >1 ``seq`` axis
-the runtime instead prefills long prompts in ONE shot through the exact
-ppermute ring (parallel/ring_attention.py) — same cache writes, same
-first-token logits (parity pinned at 1e-5 in tests/test_generation.py).
+Inactive slots ride along under a mask with their write target forced
+to the GARBAGE page 0 (a freed slot's pages may already belong to
+someone else — most importantly a shared prefix page — so the old
+"write into your own row's next free position" trick is replaced by an
+explicitly harmless destination).  Active slots past their reservation
+also fall through to page 0: unmapped block-table entries are 0 by
+construction.
+
+Prefill is chunked exactly as before, but each chunk scatters its K/V
+rows into the pages its block table maps and attends against the
+GATHERED logical row (pages reassembled to ``[Hkv, max_len, head_dim]``
+inside the executable, positional mask ``kpos <= qpos`` unchanged).
+With ``quant='int8'`` rows are stored as int8 with one float32 scale
+per (token, kv head), quantized on write and dequantized inside the
+gather — attention math stays float32.
+
+`_verify_fn` is the speculative-decode twin of the decode window: the
+same step body, but each scan step feeds a HOST-PROVIDED token (last
+emitted token + draft proposals) instead of the carry token, and the
+returned per-step samples are the target model's verdicts.  The
+rerun-deterministic ``(seed, position)`` sampling makes acceptance
+replay-stable: a verified prefix is bitwise what sequential decode
+would have produced.
 
 Every executable is compiled ahead of time and persisted through the
-compile-cache disk tier (core/compile_cache.callable_fingerprint), so a
-restarted server warm-starts its decode loop from disk; fused-vs-
-sequential and fresh-vs-restored decode are bitwise-identical.
+compile-cache disk tier (core/compile_cache.callable_fingerprint) — the
+cache spec now carries page_len/pages/quant, so geometry changes get
+fresh fingerprints.  `dense_reference` is the independent, page-free
+parity oracle.
 """
+import os
 import threading
 
 import numpy as np
 
 from ... import observability as _obs
 from ...core import compile_cache as _cc
-from ...ops.attention import cached_attention, write_cache
+from ...ops.attention import cached_attention
 from ...ops.sampling import sample_logits, sample_tokens_at, token_key
-from .kv_cache import CacheConfig, SlotAllocator, init_state
+from .kv_cache import (CacheConfig, PagePool, PrefixCache, SlotAllocator,
+                       init_state)
 
 __all__ = ['DecodeRuntime', 'dense_reference', 'weight_names',
            'random_weights']
@@ -115,118 +134,208 @@ def _ffn(w, x, i):
     return x + (gate * (hh @ w[p + 'ffn_fc3_w'])) @ w[p + 'ffn_fc2_w']
 
 
-def _prefill_fn(cfg, chunk, ring_mesh=None):
+# -------------------------------------------------- paged read / write
+
+def _quantize_rows(x):
+    """x [..., dh] f32 -> (int8 rows, f32 per-row scale).  amax/127
+    scaling, eps-clamped so an all-zero row round-trips to zeros."""
+    import jax.numpy as jnp
+    s = jnp.maximum(jnp.max(jnp.abs(x), axis=-1) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _write_rows(st, i, pg, rw, k_new, v_new, quant):
+    """Scatter per-token K/V rows into layer ``i`` of the pools.
+
+    pg/rw: [N] page ids and in-page rows; k_new/v_new: [N, Hkv, dh]
+    float32.  Rows routed to page 0 (masked/invalid targets) are
+    write-only garbage — never attended.  Returns the new state dict.
+    """
+    st = dict(st)
+    if quant:
+        qk, sk = _quantize_rows(k_new)
+        qv, sv = _quantize_rows(v_new)
+        st['k'] = st['k'].at[pg, i, :, rw, :].set(qk)
+        st['v'] = st['v'].at[pg, i, :, rw, :].set(qv)
+        st['k_scale'] = st['k_scale'].at[pg, i, :, rw].set(sk)
+        st['v_scale'] = st['v_scale'].at[pg, i, :, rw].set(sv)
+    else:
+        st['k'] = st['k'].at[pg, i, :, rw, :].set(k_new.astype(st['k'].dtype))
+        st['v'] = st['v'].at[pg, i, :, rw, :].set(v_new.astype(st['v'].dtype))
+    return st
+
+
+def _logical_rows(st, bt, i, cache):
+    """Gather layer ``i``'s logical dense rows through the block table.
+
+    bt: [B, max_pages] -> (k, v) each [B, Hkv, max_len, dh].  Unmapped
+    entries (0) pull the garbage page — those positions sit at or past
+    every live length, so the positional mask already hides them.  int8
+    pools are dequantized here; attention math stays float32.
+    """
+    import jax.numpy as jnp
+    B, M = bt.shape
+    Hkv, PL, dh = cache.kv_heads, cache.page_len, cache.head_dim
+
+    def assemble(pool, scale):
+        rows = pool[bt, i]                     # [B, M, Hkv, PL, dh]
+        rows = rows.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, M * PL, dh)
+        if scale is None:
+            return rows
+        sc = scale[bt, i]                      # [B, M, Hkv, PL]
+        sc = sc.transpose(0, 2, 1, 3).reshape(B, Hkv, M * PL)
+        return rows.astype(jnp.float32) * sc[..., None]
+
+    if cache.quant == 'int8':
+        return (assemble(st['k'], st['k_scale']),
+                assemble(st['v'], st['v_scale']))
+    return assemble(st['k'], None), assemble(st['v'], None)
+
+
+def _prefill_fn(cfg, cache, chunk, ring_mesh=None):
     """Build the one-chunk (or one-shot ring) prefill function.
 
-    Writes the chunk's K/V into one slot's cache row at ``offset``,
-    attends the chunk queries against the whole row (positional mask),
-    SETS lengths[slot] = offset + true_count (no stale-state reset is
-    ever needed), samples the would-be next token at its absolute
-    position, and stores it in tok[slot].  Intermediate chunks' samples
-    are placeholders the next chunk overwrites — only the final chunk's
-    draw (the request's FIRST token, the TTFT token) survives.
+    Scatters the chunk's K/V rows into the pages ``bt_row`` maps at the
+    chunk's absolute positions (invalid tail rows of a short final
+    chunk go to the garbage page), attends the chunk queries against
+    the gathered logical row, SETS lengths[slot] = offset + true_count,
+    samples the would-be next token at its absolute position, and
+    stores it in tok[slot].  Only the final chunk's draw (the request's
+    FIRST token, the TTFT token) survives.
     """
-    import jax
     import jax.numpy as jnp
     L = int(cfg['n_layer'])
-    Hkv = int(cfg['n_kv_head'])
-    dh = int(cfg['d_model']) // int(cfg['n_head'])
     theta = float(cfg['theta'])
-    Tmax = int(cfg['max_len'])
+    dh = int(cfg['d_model']) // int(cfg['n_head'])
+    M, PL = cache.max_pages, cache.page_len
+    quant = cache.quant == 'int8'
 
     if ring_mesh is not None:
         from ...parallel.ring_attention import ring_attention
 
-    def prefill(w, kc, vc, lengths, tok, tokens, slot, offset, true_count,
+    def prefill(w, st, bt_row, tokens, slot, offset, true_count,
                 seed, temperature, top_k):
         pos = (offset + jnp.arange(chunk))[None]          # [1, C]
+        p_abs = offset + jnp.arange(chunk)                # [C]
+        valid = jnp.arange(chunk) < true_count
+        pg = jnp.where(valid,
+                       bt_row[jnp.clip(p_abs // PL, 0, M - 1)], 0)
+        rw = p_abs % PL
         x = w['tok_emb'][tokens][None]                    # [1, C, D]
         for i in range(L):
             h = _rms(x, w['layer_%d_att_norm' % i])
             q, k, v = _qkv(w, cfg, h, i)
             q = _rope_at(q, pos, theta)
             k = _rope_at(k, pos, theta)
-            kc, vc = write_cache(kc, vc, k[0], v[0], slot, i, offset)
+            st = _write_rows(st, i, pg, rw, k[0].transpose(1, 0, 2),
+                             v[0].transpose(1, 0, 2), quant)
             if ring_mesh is not None:
                 # one-shot long-context prefill (offset == 0): the exact
                 # ppermute ring over the whole prompt
                 att = ring_attention(q, k, v, ring_mesh, causal=True)
             else:
-                row = (jax.lax.dynamic_slice(
-                    kc, (slot, i, 0, 0, 0), (1, 1, Hkv, Tmax, dh))[:, 0],
-                    jax.lax.dynamic_slice(
-                    vc, (slot, i, 0, 0, 0), (1, 1, Hkv, Tmax, dh))[:, 0])
-                att = cached_attention(q, row[0], row[1], pos)
+                kl, vl = _logical_rows(st, bt_row[None], i, cache)
+                att = cached_attention(q, kl, vl, pos)
             B, H, T = att.shape[0], att.shape[1], att.shape[2]
             att = att.transpose(0, 2, 1, 3).reshape(B, T, H * dh)
             x = x + att @ w['layer_%d_att_o_w' % i]
             x = _ffn(w, x, i)
+        import jax
         x = _rms(x, w['final_norm'])
         last = jax.lax.dynamic_slice_in_dim(x[0], true_count - 1, 1)[0]
         logits = last @ w['lm_proj_w']                    # [V] f32
         new_len = offset + true_count
         nxt = sample_logits(logits, token_key(seed, new_len),
                             temperature, top_k)
-        lengths = lengths.at[slot].set(new_len)
-        tok = tok.at[slot].set(nxt)
-        return kc, vc, lengths, tok, nxt, logits
+        st = dict(st)
+        st['lengths'] = st['lengths'].at[slot].set(new_len)
+        st['tok'] = st['tok'].at[slot].set(nxt)
+        return st, nxt, logits
 
     return prefill
 
 
-def _decode_fn(cfg, steps):
-    """Build the K-step fused decode window over ALL slots.
-
-    Each step feeds every slot's ``tok`` at its own ``lengths`` position
-    (write K/V, attend against the row, sample the next token with the
-    position-keyed stream), then advances ACTIVE slots only.  Inactive
-    slots compute masked garbage: their write lands at their row's next
-    free position — overwritten before any query can reach it — and
-    their tok/lengths do not move.  The whole window is one `lax.scan`;
-    the cache/state arrays are donated carry.
-    """
-    import jax
+def _step_fn(cfg, cache):
+    """One fused decode/verify step over ALL slots: write the fed token's
+    K/V through the block table, attend against the gathered logical
+    rows, sample each slot's next token with the position-keyed stream,
+    advance ACTIVE slots only.  Inactive slots compute masked garbage
+    routed to page 0."""
     import jax.numpy as jnp
     L = int(cfg['n_layer'])
     theta = float(cfg['theta'])
     dh = int(cfg['d_model']) // int(cfg['n_head'])
+    M, PL = cache.max_pages, cache.page_len
+    quant = cache.quant == 'int8'
 
-    def step(w, kc, vc, lengths, tok, active, seeds, temps, topks):
-        S = kc.shape[0]
-        pos = lengths                                     # [S] write pos
-        x = w['tok_emb'][tok][:, None, :]                 # [S, 1, D]
+    def step(w, st, bt, fed, active, seeds, temps, topks):
+        S = bt.shape[0]
+        pos = st['lengths']                               # [S] write pos
+        pg = bt[jnp.arange(S), jnp.clip(pos // PL, 0, M - 1)]
+        pg = jnp.where(active, pg, 0)
+        rw = pos % PL
+        x = w['tok_emb'][fed][:, None, :]                 # [S, 1, D]
         for i in range(L):
             h = _rms(x, w['layer_%d_att_norm' % i])
             q, k, v = _qkv(w, cfg, h, i)
             q = _rope_at(q, pos[:, None], theta)
             k = _rope_at(k, pos[:, None], theta)
-            write = jax.vmap(
-                lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (0, p, 0)))
-            kci = write(kc[:, i], k.astype(kc.dtype), pos)
-            vci = write(vc[:, i], v.astype(vc.dtype), pos)
-            kc = kc.at[:, i].set(kci)
-            vc = vc.at[:, i].set(vci)
-            att = cached_attention(q, kci, vci, pos[:, None])
+            st = _write_rows(st, i, pg, rw, k[:, :, 0, :], v[:, :, 0, :],
+                             quant)
+            kl, vl = _logical_rows(st, bt, i, cache)
+            att = cached_attention(q, kl, vl, pos[:, None])
             H = att.shape[1]
             att = att.transpose(0, 2, 1, 3).reshape(S, 1, H * dh)
             x = x + att @ w['layer_%d_att_o_w' % i]
             x = _ffn(w, x, i)
         x = _rms(x, w['final_norm'])
         logits = x[:, 0] @ w['lm_proj_w']                 # [S, V]
-        nxt = sample_tokens_at(logits, seeds, lengths + 1, temps, topks)
-        new_tok = jnp.where(active, nxt, tok)
-        new_len = jnp.where(active, lengths + 1, lengths)
-        return kc, vc, new_len, new_tok
+        nxt = sample_tokens_at(logits, seeds, pos + 1, temps, topks)
+        st = dict(st)
+        st['tok'] = jnp.where(active, nxt, st['tok'])
+        st['lengths'] = jnp.where(active, pos + 1, pos)
+        return st, nxt
 
-    def window(w, kc, vc, lengths, tok, active, seeds, temps, topks):
+    return step
+
+
+def _decode_fn(cfg, cache, steps):
+    """K-step fused decode window: each step feeds every slot's own
+    carry token.  One `lax.scan`; the state dict is donated carry; the
+    block table is closed-over DATA (an ordinary traced argument)."""
+    import jax
+
+    step = _step_fn(cfg, cache)
+
+    def window(w, st, bt, active, seeds, temps, topks):
         def body(carry, _):
-            kc, vc, lengths, tok = carry
-            kc, vc, lengths, tok = step(w, kc, vc, lengths, tok, active,
-                                        seeds, temps, topks)
-            return (kc, vc, lengths, tok), tok
-        (kc, vc, lengths, tok), toks = jax.lax.scan(
-            body, (kc, vc, lengths, tok), None, length=steps)
-        return kc, vc, lengths, tok, toks.T               # [S, K]
+            carry, nxt = step(w, carry, bt, carry['tok'], active, seeds,
+                              temps, topks)
+            return carry, nxt
+        st, toks = jax.lax.scan(body, st, None, length=steps)
+        return st, toks.T                                 # [S, K]
+
+    return window
+
+
+def _verify_fn(cfg, cache, steps):
+    """K-step speculative VERIFY window: identical step body, but step j
+    feeds ``fed[j]`` (host-built: last emitted token, then the draft's
+    proposals) and the returned samples are the target model's verdicts
+    g_j at each position.  Same `(seed, position)` sampling as decode —
+    an accepted prefix is bitwise the sequential stream."""
+    import jax
+
+    step = _step_fn(cfg, cache)
+
+    def window(w, st, bt, fed, active, seeds, temps, topks):
+        def body(carry, fed_t):
+            carry, nxt = step(w, carry, bt, fed_t, active, seeds, temps,
+                              topks)
+            return carry, nxt
+        st, toks = jax.lax.scan(body, st, fed)            # fed: [K, S]
+        return st, toks.T                                 # [S, K]
 
     return window
 
@@ -270,19 +379,48 @@ def dense_reference(weights, cfg, prompt):
             np.asarray(logits))
 
 
+def _env_quant(kv_quant):
+    if kv_quant is not None:
+        q = str(kv_quant)
+    else:
+        q = os.environ.get('PT_KV_QUANT', 'none')
+    return 'none' if q.strip().lower() in ('', '0', 'none', 'off',
+                                           'false') else q.strip().lower()
+
+
+def _env_prefix(prefix_cache):
+    if prefix_cache is not None:
+        return bool(prefix_cache)
+    return os.environ.get('PT_PREFIX_CACHE', '1').strip().lower() not in (
+        '0', 'off', 'false', '')
+
+
 class DecodeRuntime(object):
-    """The device half of the streaming decode server: slotted KV cache
-    state + AOT prefill/decode executables over one weight set.
+    """The device half of the streaming decode server: the paged KV
+    pool + block tables + AOT prefill/decode/verify executables over one
+    weight set.
 
     ``weights`` maps llama parameter names to arrays (a trained scope
     via models.llama.generation_weights, or `random_weights` for tests);
     ``cfg`` is the model config dict.  ``mesh`` (optional, with a >1
     ``seq`` axis) enables one-shot ring prefill for prompts of at least
     ``ring_min_len`` tokens.
+
+    Paging knobs: ``page_len`` (default: largest divisor of max_len
+    <= 8), ``pages`` (pool depth incl. the garbage page; default =
+    dense-equivalent capacity), ``kv_quant`` ('none'/'int8', default
+    env PT_KV_QUANT), ``prefix_cache`` (default env PT_PREFIX_CACHE,
+    on).  A slot is a batch row; PAGES are the memory: admission goes
+    through `try_begin` (prefix-cache match + all-or-nothing page
+    claim) and per-window `ensure_capacity`, both of which report
+    shortage as a clean False/None the scheduler turns into
+    backpressure or a terminal ``kv_oom``.
     """
 
     def __init__(self, weights, cfg, slots=4, prefill_chunk=8,
-                 cache_dtype='float32', mesh=None, ring_min_len=None):
+                 cache_dtype='float32', mesh=None, ring_min_len=None,
+                 page_len=None, pages=None, kv_quant=None,
+                 prefix_cache=None):
         import jax.numpy as jnp
         self.cfg = dict(cfg)
         self.w = {n: jnp.asarray(weights[n]) for n in weight_names(cfg)}
@@ -290,8 +428,17 @@ class DecodeRuntime(object):
         self.cache = CacheConfig(
             slots=slots, layers=int(cfg['n_layer']),
             kv_heads=int(cfg['n_kv_head']), max_len=int(cfg['max_len']),
-            head_dim=int(cfg['d_model']) // H, dtype=cache_dtype)
+            head_dim=int(cfg['d_model']) // H, dtype=cache_dtype,
+            page_len=page_len, pages=pages, quant=_env_quant(kv_quant))
         self.allocator = SlotAllocator(self.cache.slots)
+        self.pool = PagePool(self.cache)
+        self.prefix = (PrefixCache(self.pool, self.cache.page_len)
+                       if _env_prefix(prefix_cache) else None)
+        S = self.cache.slots
+        self.block_tables = np.zeros((S, self.cache.max_pages), np.int32)
+        self.owned = [[] for _ in range(S)]
+        self.host_len = np.zeros(S, np.int32)
+        self.host_tok = np.zeros(S, np.int32)
         self.state = init_state(self.cache)
         self.prefill_chunk = int(prefill_chunk)
         if not 0 < self.prefill_chunk <= self.cache.max_len:
@@ -320,13 +467,105 @@ class DecodeRuntime(object):
         return self.allocator.alloc()
 
     def free_slot(self, slot):
+        """Retire a slot: release every page its block table maps (a
+        shared prefix page survives in the cache / other streams) and
+        unmap the row.  Pages are never zeroed — positional masking
+        keeps stale rows unreachable."""
+        slot = int(slot)
+        pages, self.owned[slot] = self.owned[slot], []
+        if pages:
+            self.pool.release(pages)
+        self.block_tables[slot] = 0
+        self.host_len[slot] = 0
+        self.host_tok[slot] = 0
         self.allocator.free(slot)
 
     def reset(self):
-        """Fresh state + allocator (the weights and warm executables
+        """Fresh state + allocators (the weights and warm executables
         stay)."""
+        for s in range(self.cache.slots):
+            self.owned[s] = []
+        if self.prefix is not None:
+            self.prefix.reset()
         self.allocator.reset()
+        self.pool.reset()
+        self.block_tables[:] = 0
+        self.host_len[:] = 0
+        self.host_tok[:] = 0
         self.state = init_state(self.cache)
+
+    # ------------------------------------------------ page accounting
+    def never_fits(self, prompt_len, max_new):
+        """True when prompt+max_new could not run even on an idle pool —
+        the admission-time terminal ``kv_oom``."""
+        span = min(int(prompt_len) + int(max_new), self.cache.max_len)
+        return self.cache.pages_for(span) > self.pool.capacity
+
+    def try_begin(self, slot, prompt, window):
+        """Claim pages for ``prompt`` plus one decode window on
+        ``slot``: longest shared-prefix match first (those pages are
+        mapped read-only — full by construction, so the request's own
+        writes start in its first fresh page), then an all-or-nothing
+        claim of the remainder.  Returns the PREFILL START OFFSET
+        (matched tokens are skipped), or None on page shortage with
+        nothing leaked — the scheduler's backpressure signal."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        span = min(prompt.size + max(1, int(window)), self.cache.max_len)
+        need = self.cache.pages_for(span)
+        matched = self.prefix.match(prompt) if self.prefix is not None else []
+        evict = self.prefix.evict_one if self.prefix is not None else None
+        fresh = self.pool.alloc(max(0, need - len(matched)), evict=evict)
+        if fresh is None:
+            if matched:
+                self.pool.release(matched)
+            return None
+        pages = list(matched) + list(fresh)
+        self.owned[slot] = pages
+        self.block_tables[slot] = 0
+        self.block_tables[slot, :len(pages)] = pages
+        self.host_len[slot] = 0
+        self.host_tok[slot] = 0
+        return len(matched) * self.cache.page_len
+
+    def ensure_capacity(self, slot, target_len):
+        """Grow ``slot``'s block table to cover ``target_len`` tokens.
+        True when already covered or grown; False on pool exhaustion
+        (mid-stream ``kv_oom`` — the caller retires the stream with a
+        terminal reply, never truncates silently)."""
+        slot = int(slot)
+        need = self.cache.pages_for(min(int(target_len),
+                                        self.cache.max_len))
+        have = len(self.owned[slot])
+        if need <= have:
+            return True
+        evict = self.prefix.evict_one if self.prefix is not None else None
+        fresh = self.pool.alloc(need - have, evict=evict)
+        if fresh is None:
+            return False
+        self.owned[slot].extend(fresh)
+        self.block_tables[slot, have:need] = fresh
+        return True
+
+    def promote_prefix(self, slot, prompt):
+        """Publish a freshly-prefilled prompt's full pages into the
+        prefix cache (no-op when prefix caching is off)."""
+        if self.prefix is None:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        full = prompt.size // self.cache.page_len
+        return self.prefix.insert(prompt, self.owned[int(slot)][:full])
+
+    def pool_snapshot(self):
+        """Host-side pool gauges (flight-dump payload on kv_oom/breaker
+        trips)."""
+        return {'pages_capacity': self.pool.capacity,
+                'pages_in_use': self.pool.in_use(),
+                'page_bytes': self.pool.page_bytes,
+                'bytes_reserved': self.cache.bytes(),
+                'bytes_live': self.pool.in_use() * self.pool.page_bytes,
+                'prefix_entries': (len(self.prefix)
+                                   if self.prefix is not None else 0),
+                'slots_in_use': self.allocator.in_use()}
 
     # ---------------------------------------------------------- AOT
     def _param_specs(self):
@@ -381,52 +620,70 @@ class DecodeRuntime(object):
                                                  PartitionSpec()))
 
     def _state_structs(self):
-        st = self.state
-        return [self._sds(a.shape, a.dtype)
-                for a in (st['k'], st['v'], st['lengths'], st['tok'])]
+        return {n: self._sds(a.shape, a.dtype)
+                for n, a in self.state.items()}
+
+    def _bt_struct(self, rows):
+        import jax
+        return self._sds((rows, self.cache.max_pages), jax.numpy.int32)
 
     def _prefill_exec(self, chunk, ring=False):
         import jax
 
         def build():
-            fn = _prefill_fn(self.cfg, chunk,
+            fn = _prefill_fn(self.cfg, self.cache, chunk,
                              ring_mesh=self.mesh if ring else None)
-            jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+            jitted = jax.jit(fn, donate_argnums=(1,))
             i32 = self._sds((), jax.numpy.int32)
             f32 = self._sds((), jax.numpy.float32)
             params = {n: self._sds(a.shape, a.dtype)
                       for n, a in self.w.items()}
             toks = self._sds((chunk,), jax.numpy.int32)
-            args = [params] + self._state_structs() + \
-                [toks, i32, i32, i32, i32, f32, i32]
+            bt_row = self._sds((self.cache.max_pages,), jax.numpy.int32)
+            args = [params, self._state_structs(), bt_row, toks,
+                    i32, i32, i32, i32, f32, i32]
             return jitted, args
 
         return self._compiled(('prefill_ring' if ring else 'prefill',
                                chunk), build)
 
-    def _decode_exec(self, steps):
+    def _window_exec(self, kind, steps):
         import jax
 
         def build():
-            fn = _decode_fn(self.cfg, steps)
-            jitted = jax.jit(fn, donate_argnums=(1, 2, 3, 4))
+            if kind == 'verify':
+                fn = _verify_fn(self.cfg, self.cache, steps)
+            else:
+                fn = _decode_fn(self.cfg, self.cache, steps)
+            jitted = jax.jit(fn, donate_argnums=(1,))
             S = self.cache.slots
             vec = lambda dt: self._sds((S,), dt)  # noqa: E731
             params = {n: self._sds(a.shape, a.dtype)
                       for n, a in self.w.items()}
-            args = [params] + self._state_structs() + \
-                [vec(jax.numpy.bool_), vec(jax.numpy.int32),
-                 vec(jax.numpy.float32), vec(jax.numpy.int32)]
+            args = [params, self._state_structs(), self._bt_struct(S)]
+            if kind == 'verify':
+                args.append(self._sds((steps, S), jax.numpy.int32))
+            args += [vec(jax.numpy.bool_), vec(jax.numpy.int32),
+                     vec(jax.numpy.float32), vec(jax.numpy.int32)]
             return jitted, args
 
-        return self._compiled(('decode', steps), build)
+        return self._compiled((kind, steps), build)
 
-    def warmup(self, steps=None):
+    def _decode_exec(self, steps):
+        return self._window_exec('decode', steps)
+
+    def _verify_exec(self, steps):
+        return self._window_exec('verify', steps)
+
+    def warmup(self, steps=None, speculative=False):
         """Compile (or disk-load) the steady-state executables up front
-        so the first request pays no compile latency."""
+        so the first request pays no compile latency.  With
+        ``speculative`` the verify window is warmed too."""
         self._prefill_exec(self.prefill_chunk)
         if steps:
             self._decode_exec(int(steps))
+            if speculative:
+                self._verify_exec(int(steps))
 
     # -------------------------------------------------------- prefill
     def prefill(self, slot, tokens, offset, params):
@@ -434,7 +691,8 @@ class DecodeRuntime(object):
         of the prompt (the final chunk may be short — it is padded to
         the chunk width and masked by ``true_count``).  Returns
         (next_token, logits) — meaningful only on the final chunk.
-        ``params`` is a SamplingParams."""
+        ``params`` is a SamplingParams.  The slot's block table must
+        already cover the chunk (`try_begin`/`ensure_capacity`)."""
         import jax.numpy as jnp
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         n = tokens.shape[0]
@@ -446,13 +704,14 @@ class DecodeRuntime(object):
         buf = np.zeros(self.prefill_chunk, np.int32)
         buf[:n] = tokens
         call = self._prefill_exec(self.prefill_chunk)
-        st = self.state
-        k, v, lengths, tok, nxt, logits = call(
-            self.w, st['k'], st['v'], st['lengths'], st['tok'],
+        st, nxt, logits = call(
+            self.w, self.state, jnp.asarray(self.block_tables[slot]),
             jnp.asarray(buf), jnp.int32(slot), jnp.int32(offset),
             jnp.int32(n), jnp.int32(params.seed),
             jnp.float32(params.temperature), jnp.int32(params.top_k))
-        self.state = {'k': k, 'v': v, 'lengths': lengths, 'tok': tok}
+        self.state = st
+        self.host_len[slot] = offset + n
+        self.host_tok[slot] = int(nxt)
         return int(nxt), np.asarray(logits)
 
     def ring_pad(self, n):
@@ -477,48 +736,116 @@ class DecodeRuntime(object):
         buf = np.zeros(width, np.int32)
         buf[:n] = prompt
         call = self._prefill_exec(width, ring=True)
-        st = self.state
-        k, v, lengths, tok, nxt, logits = call(
-            self.w, st['k'], st['v'], st['lengths'], st['tok'],
+        st, nxt, logits = call(
+            self.w, self.state, jnp.asarray(self.block_tables[slot]),
             jnp.asarray(buf), jnp.int32(slot), jnp.int32(0),
             jnp.int32(n), jnp.int32(params.seed),
             jnp.float32(params.temperature), jnp.int32(params.top_k))
-        self.state = {'k': k, 'v': v, 'lengths': lengths, 'tok': tok}
+        self.state = st
+        self.host_len[slot] = n
+        self.host_tok[slot] = int(nxt)
         return int(nxt), np.asarray(logits)
 
     # --------------------------------------------------------- decode
+    def _vecs(self, active, seeds, temps, topks):
+        import jax.numpy as jnp
+        S = self.cache.slots
+        return (jnp.asarray(np.asarray(active, bool).reshape(S)),
+                jnp.asarray(np.asarray(seeds, np.int32).reshape(S)),
+                jnp.asarray(np.asarray(temps, np.float32).reshape(S)),
+                jnp.asarray(np.asarray(topks, np.int32).reshape(S)))
+
     def decode_window(self, steps, active, seeds, temps, topks):
         """Advance every ACTIVE slot ``steps`` tokens in one fused
         launch.  active/seeds/temps/topks are per-slot vectors (plain
-        data — they never retrace).  Returns the [slots, steps] token
-        matrix; inactive rows are garbage by contract."""
+        data — they never retrace); so is the block table.  Returns the
+        [slots, steps] token matrix; inactive rows are garbage by
+        contract."""
         import jax.numpy as jnp
         call = self._decode_exec(int(steps))
-        st = self.state
-        S = self.cache.slots
-        k, v, lengths, tok, toks = call(
-            self.w, st['k'], st['v'], st['lengths'], st['tok'],
-            jnp.asarray(np.asarray(active, bool).reshape(S)),
-            jnp.asarray(np.asarray(seeds, np.int32).reshape(S)),
-            jnp.asarray(np.asarray(temps, np.float32).reshape(S)),
-            jnp.asarray(np.asarray(topks, np.int32).reshape(S)))
-        self.state = {'k': k, 'v': v, 'lengths': lengths, 'tok': tok}
+        act = np.asarray(active, bool).reshape(self.cache.slots)
+        st, toks = call(self.w, self.state, jnp.asarray(self.block_tables),
+                        *self._vecs(act, seeds, temps, topks))
+        self.state = st
+        out = np.asarray(toks)
+        self.host_len[act] = np.minimum(
+            self.host_len[act] + int(steps), np.iinfo(np.int32).max)
+        self.host_tok[act] = out[act, -1]
+        return out
+
+    def verify_window(self, steps, fed, active, seeds, temps, topks):
+        """Speculative verify: feed ``fed`` [slots, steps] (host-built
+        per-slot rows: last emitted token then draft proposals) through
+        the fused window; returns the [slots, steps] TARGET samples
+        g_0..g_{K-1}.  Device lengths advance K for active slots — the
+        caller MUST follow with `commit_speculation` (the host-side
+        rollback) before any other launch."""
+        import jax.numpy as jnp
+        call = self._verify_exec(int(steps))
+        fed = np.asarray(fed, np.int32).reshape(self.cache.slots,
+                                                int(steps))
+        st, toks = call(self.w, self.state, jnp.asarray(self.block_tables),
+                        jnp.asarray(fed.T),
+                        *self._vecs(active, seeds, temps, topks))
+        self.state = st
         return np.asarray(toks)
+
+    def commit_speculation(self, accepted):
+        """Roll the post-verify state back to the accepted prefix.
+
+        ``accepted`` maps slot -> (m, last_token): m tokens of the
+        window were emitted (1 <= m <= K) and ``last_token`` (g_{m-1})
+        is the next token to feed.  Every ACTIVE slot of the verify
+        window must appear.  Rejected positions' K/V rows stay in the
+        pool but sit at/past the committed length — unreachable under
+        the positional mask and overwritten by the next window (pages
+        are never shared at write positions).  Pure host-side metadata:
+        the [slots] lengths/tok vectors are re-uploaded, no executable
+        runs, nothing retraces."""
+        import jax.numpy as jnp
+        for slot, (m, last_tok) in accepted.items():
+            self.host_len[int(slot)] += int(m)
+            self.host_tok[int(slot)] = int(last_tok)
+        st = dict(self.state)
+        st['lengths'] = jnp.asarray(self.host_len.astype(np.int32))
+        st['tok'] = jnp.asarray(self.host_tok.astype(np.int32))
+        self.state = st
 
     # ----------------------------------------------- test conveniences
     def cache_row(self, slot):
-        """Host copies (k [L, Hkv, Tmax, dh], v, length) of one slot."""
+        """Host copies (k [L, Hkv, Tmax, dh], v, length) of one slot's
+        LOGICAL row, reassembled (and dequantized) through its block
+        table."""
         st = self.state
-        return (np.asarray(st['k'][slot]), np.asarray(st['v'][slot]),
-                int(np.asarray(st['lengths'][slot])))
+        bt = self.block_tables[int(slot)]
+        L, Hkv = self.cache.layers, self.cache.kv_heads
+        Tmax, dh = self.cache.max_len, self.cache.head_dim
+
+        def assemble(pool, scale):
+            rows = np.asarray(pool)[bt]        # [M, L, Hkv, PL, dh]
+            rows = rows.transpose(1, 2, 0, 3, 4).reshape(L, Hkv, Tmax, dh)
+            if scale is None:
+                return rows
+            sc = np.asarray(scale)[bt]         # [M, L, Hkv, PL]
+            sc = sc.transpose(1, 2, 0, 3).reshape(L, Hkv, Tmax)
+            return rows.astype(np.float32) * sc[..., None]
+
+        if self.cache.quant == 'int8':
+            k = assemble(st['k'], st['k_scale'])
+            v = assemble(st['v'], st['v_scale'])
+        else:
+            k, v = assemble(st['k'], None), assemble(st['v'], None)
+        return k, v, int(np.asarray(st['lengths'][int(slot)]))
 
     def generate(self, prompt, max_new, params=None, steps_per_window=4,
-                 use_ring=False):
+                 use_ring=False, speculative=False):
         """Single-request convenience decode (tests, parity references):
         prefill the prompt, then advance in fused windows; returns the
         generated ids (list, length max_new).  steps_per_window=1 IS the
-        sequential single-token reference path."""
-        from .sampling import SamplingParams
+        sequential single-token reference path.  ``speculative`` runs
+        draft-propose + fused-verify windows instead of plain decode
+        (greedy streams are bitwise identical either way)."""
+        from .sampling import SamplingParams, draft_ngram
         params = params or SamplingParams()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size + int(max_new) > self.cache.max_len:
@@ -529,14 +856,23 @@ class DecodeRuntime(object):
         slot = self.alloc_slot()
         if slot is None:
             raise RuntimeError('no free kv slot')
+        started = False
         try:
+            start = self.try_begin(slot, prompt, int(max_new))
+            if start is None:
+                raise RuntimeError(
+                    'kv_oom: pool of %d pages cannot hold prompt of %d + '
+                    'max_new=%d' % (self.pool.capacity, prompt.size,
+                                    max_new))
+            started = True
+            first = None
             if use_ring:
                 first, _ = self.prefill_ring(slot, prompt, params)
             else:
-                first = None
-                for off in range(0, prompt.size, self.prefill_chunk):
+                for off in range(start, prompt.size, self.prefill_chunk):
                     chunk = prompt[off:off + self.prefill_chunk]
                     first, _ = self.prefill(slot, chunk, off, params)
+            self.promote_prefix(slot, prompt)
             out = [int(first)]
             S = self.cache.slots
             active = np.zeros(S, bool)
@@ -547,10 +883,35 @@ class DecodeRuntime(object):
             seeds[slot] = params.seed
             temps[slot] = params.temperature
             topks[slot] = params.top_k
+            K = int(steps_per_window)
             while len(out) < int(max_new):
-                toks = self.decode_window(int(steps_per_window), active,
-                                          seeds, temps, topks)
-                out.extend(int(t) for t in toks[slot])
+                if not self.ensure_capacity(
+                        slot, self.host_len[slot] + K):
+                    raise RuntimeError('kv_oom: pool exhausted mid-stream')
+                if speculative:
+                    ctx = np.concatenate([prompt, np.asarray(out,
+                                                             np.int32)])
+                    fed = np.zeros((S, K), np.int32)
+                    fed[slot, 0] = out[-1]
+                    fed[slot, 1:] = draft_ngram(ctx, K - 1)
+                    g = self.verify_window(K, fed, active, seeds, temps,
+                                           topks)[slot]
+                    m = 1
+                    while m < K and fed[slot, m] == g[m - 1]:
+                        m += 1
+                    _obs.metrics.counter(
+                        'generation.spec_proposed').inc(K - 1)
+                    _obs.metrics.counter(
+                        'generation.spec_accepted').inc(m - 1)
+                    self.commit_speculation({slot: (m, int(g[m - 1]))})
+                    out.extend(int(t) for t in g[:m])
+                else:
+                    toks = self.decode_window(K, active, seeds, temps,
+                                              topks)
+                    out.extend(int(t) for t in toks[slot])
             return out[:int(max_new)]
         finally:
-            self.free_slot(slot)
+            if started:
+                self.free_slot(slot)
+            else:
+                self.allocator.free(slot)

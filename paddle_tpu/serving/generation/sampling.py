@@ -12,11 +12,36 @@ absolute position ``p`` of a request with seed ``s`` is drawn with key
 ``fold_in(key(s), p)`` — independent of batch composition, window size
 K, scheduler interleaving, and fresh-vs-restored executables.
 """
+import numpy as np
+
 from ...ops.sampling import (sample_logits, sample_tokens_at,  # noqa
                              token_key)
 
-__all__ = ['SamplingParams', 'sample_logits', 'sample_tokens_at',
-           'token_key']
+__all__ = ['SamplingParams', 'draft_ngram', 'sample_logits',
+           'sample_tokens_at', 'token_key']
+
+
+def draft_ngram(context, k):
+    """Prompt-lookup draft for speculative decode: propose the ``k``
+    tokens that followed the most recent PRIOR occurrence of the
+    context's last token (padding with that token when history runs
+    out).  Pure host-side and deterministic — the proposal quality only
+    affects the accept rate, never correctness: the fused verify window
+    samples the target model at every position and the stream keeps
+    exactly the tokens the target would have produced anyway."""
+    context = np.asarray(context, np.int32).reshape(-1)
+    k = int(k)
+    if k <= 0:
+        return np.zeros(0, np.int32)
+    out = np.full(k, context[-1] if context.size else 0, np.int32)
+    if context.size >= 2:
+        last = context[-1]
+        hits = np.flatnonzero(context[:-1] == last)
+        if hits.size:
+            start = int(hits[-1]) + 1
+            follow = context[start:start + k]
+            out[:follow.size] = follow
+    return out
 
 
 class SamplingParams(object):

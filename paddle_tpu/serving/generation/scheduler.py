@@ -7,20 +7,41 @@ subclass replaces the dispatch loop with a round-based scheduler over
 the DecodeRuntime's KV slots:
 
   round := sweep (cancel / deadline / TTFT / ITL)
-         → claim queued requests into free slots
+         → claim queued requests into free slots WITH pages
          → ONE prefill chunk for the oldest still-prefilling request
-         → ONE fused decode window for ALL decoding slots
+         → ONE fused decode (or speculative verify) window for ALL
+           decoding slots
 
-Long prompts therefore advance one bounded chunk per round, interleaved
-with full-width decode windows — a prompt of any length never stalls
-token delivery for running requests (``generation.mixed_dispatches``
-counts rounds that did both).  A request lives in one slot from prefill
+Memory admission is PAGED (kv_cache.PagePool): a queued request is
+claimed only when a slot AND the pages for its prompt plus one decode
+window are both available — pool shortage leaves it QUEUED
+(``generation.kv_backpressure``), it is never truncated.  A request
+whose prompt+max_new could not fit even an idle pool is refused at
+admission with reason ``kv_oom``; a stream whose window cannot grow
+mid-flight retires with a terminal ``error``/``kv_oom`` reply and a
+flight dump carrying the pool gauge snapshot.  Prefix-cache hits skip
+straight to their first unshared chunk (`DecodeRuntime.try_begin`) and
+completed prompts are published for later requests (`promote_prefix`).
+
+With ``GenerationConfig.speculative`` (default off; env
+``PT_SPEC_DECODE``) the decode window becomes draft-propose + fused
+VERIFY: a host-side n-gram draft proposes K-1 tokens per stream, one
+batched verify pass samples the target model at every position, and
+each stream keeps the longest accepted prefix
+(``generation.spec_proposed`` / ``spec_accepted``) — greedy streams
+are bitwise identical to non-speculative decode.
+
+Long prompts advance one bounded chunk per round, interleaved with
+full-width decode windows — a prompt of any length never stalls token
+delivery for running requests (``generation.mixed_dispatches`` counts
+rounds that did both).  A request lives in one slot from prefill
 through decode (migration is in place by construction) and every
 admitted request keeps the PR-8 guarantee: exactly one terminal reply —
 ``ok`` (reason ``eos`` / ``max_tokens``), ``deadline_exceeded`` (queue
 wait, overall deadline, TTFT or ITL budget), ``shed`` (cancel, drain),
-``rejected`` (admission), or ``error`` (decode fault) — through drain,
-stop, and injected ``decode_step`` faults alike.
+``rejected`` (admission), or ``error`` (decode fault / mid-stream
+``kv_oom``) — through drain, stop, and injected ``decode_step`` /
+``kv_oom`` faults alike.
 
 Token-level SLOs: ``serving.ttft_ms`` observes submit→first-token per
 request, ``serving.itl_ms`` the amortized inter-token gap; both export
@@ -46,10 +67,15 @@ class GenerationConfig(object):
     """Generation-side knobs (the queue/rate/breaker knobs stay on
     ServingConfig).  ``decode_window`` is K, the tokens-per-launch of
     the fused decode scan; ``ttft_timeout_s`` / ``itl_timeout_s`` are
-    the default per-token SLO budgets (overridable per request)."""
+    the default per-token SLO budgets (overridable per request);
+    ``speculative`` swaps the decode window for draft + fused verify
+    (default off; env ``PT_SPEC_DECODE=1`` turns it on, ``=0`` is a
+    hard kill switch over an explicit True)."""
 
     def __init__(self, decode_window=4, eos_id=None, max_new_default=16,
-                 ttft_timeout_s=None, itl_timeout_s=None):
+                 ttft_timeout_s=None, itl_timeout_s=None,
+                 speculative=None):
+        import os
         if int(decode_window) < 1:
             raise ValueError('decode_window must be >= 1')
         self.decode_window = int(decode_window)
@@ -57,6 +83,13 @@ class GenerationConfig(object):
         self.max_new_default = int(max_new_default)
         self.ttft_timeout_s = ttft_timeout_s
         self.itl_timeout_s = itl_timeout_s
+        env = os.environ.get('PT_SPEC_DECODE', '').strip().lower()
+        if env in ('0', 'off', 'false'):
+            self.speculative = False
+        elif speculative is None:
+            self.speculative = env in ('1', 'on', 'true')
+        else:
+            self.speculative = bool(speculative)
 
 
 class _GenRequest(_Request):
@@ -169,6 +202,18 @@ class GenerationEngine(ServingEngine):
                 'context window max_len=%d; shorten the prompt or lower '
                 'max_new — nothing is silently truncated'
                 % (prompt.size, int(max_new), limit), trace, t_pc)
+        never_fits = getattr(self.runtime, 'never_fits', None)
+        if never_fits is not None and never_fits(prompt.size, int(max_new)):
+            # transient pool pressure means WAIT (backpressure), but a
+            # request bigger than the whole pool can never run: refuse
+            # with the arithmetic spelled out rather than deadlock it
+            return self._rejected_gen(
+                t_submit, 'kv_oom',
+                'prompt of %d tokens + max_new=%d needs more KV pages '
+                'than the entire pool holds (%d pages of %d tokens); '
+                'nothing is silently truncated'
+                % (prompt.size, int(max_new), self.runtime.pool.capacity,
+                   self.runtime.cache.page_len), trace, t_pc)
         if timeout_s is None:
             timeout_s = self._cfg.default_timeout_s
         deadline = None
@@ -233,11 +278,23 @@ class GenerationEngine(ServingEngine):
                 self._queue = type(self._queue)(
                     r for r in self._queue if id(r) not in gone)
             while self._queue:
+                nxt = self._queue[0]
                 slot = self.runtime.alloc_slot()
                 if slot is None:
                     break
+                start = self.runtime.try_begin(slot, nxt.prompt,
+                                               self._gen.decode_window)
+                if start is None:
+                    # pool shortage: the request STAYS QUEUED (admission
+                    # backpressure) and the slot goes back — retiring
+                    # streams free pages and the next round re-claims
+                    self.runtime.free_slot(slot)
+                    _obs.metrics.counter(
+                        'generation.kv_backpressure').inc()
+                    break
                 r = self._queue.popleft()
                 r.slot = slot
+                r.offset = int(start)   # prefix-cache hits skip ahead
                 self._active.append(r)
             _obs.metrics.gauge('serving.queue_depth').set(len(self._queue))
             self._cond.notify_all()
@@ -315,18 +372,41 @@ class GenerationEngine(ServingEngine):
                       'slot': int(r.slot), 'offset': int(r.offset),
                       'ring': bool(use_ring)})
         if r.offset >= r.prompt.size:
-            # prompt complete: the final chunk's sample IS the first
-            # token (TTFT)
+            # prompt complete: publish its full pages for later
+            # prefix-sharing requests, then emit the final chunk's
+            # sample — the first token (TTFT)
+            self.runtime.promote_prefix(r.slot, r.prompt)
             self._emit_tokens(r, [int(first)])
         return True
 
     def _decode_step(self):
-        """One fused K-token window over every decoding slot."""
+        """One fused K-token window (plain decode or speculative
+        verify) over every decoding slot."""
         rt = self.runtime
         dec = [r for r in self._active if r.offset >= r.prompt.size]
         if not dec:
             return False
         S, K = rt.slots, self._gen.decode_window
+        # grow every stream's block table to cover this window FIRST: a
+        # stream the pool cannot grow gets a terminal kv_oom reply (it
+        # is never truncated and never silently stalled) and its freed
+        # pages may rescue the streams after it
+        for r in list(dec):
+            if rt.ensure_capacity(r.slot, int(rt.host_len[r.slot]) + K):
+                continue
+            _obs.metrics.counter('generation.kv_oom').inc()
+            snap = rt.pool_snapshot()
+            _flight.record('serving.kv_oom', slot=int(r.slot),
+                           produced=int(r.produced), **snap)
+            dec.remove(r)
+            self._retire(
+                r, ERROR, reason='kv_oom',
+                error='KV page pool exhausted mid-stream (%d/%d pages '
+                      'live); partial output is in tokens_so_far()'
+                      % (snap['pages_in_use'], snap['pages_capacity']))
+            _flight.maybe_dump('kv_oom', extra={'kv_pool': snap})
+        if not dec:
+            return False
         active = np.zeros(S, bool)
         seeds = np.zeros(S, np.int32)
         temps = np.zeros(S, np.float32)
@@ -336,11 +416,18 @@ class GenerationEngine(ServingEngine):
             seeds[r.slot] = r.params.seed
             temps[r.slot] = r.params.temperature
             topks[r.slot] = r.params.top_k
+        speculative = self._gen.speculative and K > 1
         t0 = time.perf_counter()
         try:
             if _faults.any_active():
                 _faults.maybe_fail('decode_step')
-            toks = rt.decode_window(K, active, seeds, temps, topks)
+            if speculative:
+                emitted = self._verify_step(dec, K, active, seeds, temps,
+                                            topks)
+            else:
+                toks = rt.decode_window(K, active, seeds, temps, topks)
+                emitted = {id(r): [int(t) for t in toks[r.slot]]
+                           for r in dec}
         except BaseException as e:  # noqa: BLE001 - replied per request
             self.breaker.record_failure()
             _obs.metrics.counter('serving.batch_failures').inc()
@@ -357,10 +444,43 @@ class GenerationEngine(ServingEngine):
             _obs.tracing.recorder().add_complete(
                 'serving.decode_step', t0, time.perf_counter(),
                 cat='serving', args={'steps': int(K), 'requests': len(dec),
+                                     'speculative': bool(speculative),
                                      'links': links})
         for r in list(dec):
-            self._emit_tokens(r, [int(t) for t in toks[r.slot]])
+            self._emit_tokens(r, emitted[id(r)])
         return True
+
+    def _verify_step(self, dec, K, active, seeds, temps, topks):
+        """One speculative window: build each stream's fed row (last
+        emitted token + n-gram draft), run the fused verify, keep the
+        longest accepted prefix per stream, and roll the runtime back
+        to the committed lengths.  Returns {id(request): tokens}."""
+        from .sampling import draft_ngram
+        rt = self.runtime
+        S = rt.slots
+        fed = np.zeros((S, K), np.int32)
+        for r in dec:
+            fed[r.slot, 0] = rt.host_tok[r.slot]
+            ctx = np.concatenate([
+                r.prompt, np.asarray(r.future.tokens_so_far(), np.int32)])
+            fed[r.slot, 1:] = draft_ngram(ctx, K - 1)
+        g = rt.verify_window(K, fed, active, seeds, temps, topks)
+        emitted, accepted, kept = {}, {}, 0
+        for r in dec:
+            row = g[r.slot]
+            m = 1
+            while m < K and fed[r.slot, m] == row[m - 1]:
+                m += 1
+            accepted[r.slot] = (m, int(row[m - 1]))
+            emitted[id(r)] = [int(t) for t in row[:m]]
+            kept += m - 1
+        _obs.metrics.counter('generation.spec_proposed').inc(
+            (K - 1) * len(dec))
+        _obs.metrics.counter('generation.spec_accepted').inc(kept)
+        # commit BEFORE emitting: finishing streams retire (and free
+        # their pages) with the runtime already consistent
+        rt.commit_speculation(accepted)
+        return emitted
 
     # ----------------------------------------------------- token path
     def _emit_tokens(self, r, toks):

@@ -9,6 +9,12 @@ reply keeps the PR-8 contract exactly — one ServeResult, resolved once
 closes the token iterator.  ``cancel()`` is a client-side flag the
 scheduler sweeps at the next round boundary, retiring the request as a
 ``shed`` reply with reason ``cancelled``.
+
+Memory pressure surfaces here too: a stream the paged KV pool cannot
+grow mid-flight is closed with a terminal ``error`` reply carrying
+reason ``kv_oom`` — the tokens already streamed stay readable via
+``tokens_so_far()``, and the output is NEVER silently truncated into a
+fake ``ok``.
 """
 import queue
 import threading

@@ -70,6 +70,12 @@ Instrumented sites (kept in sync with docs/robustness.md):
                    every decoding request gets an error reply, the KV
                    slots free, and the breaker counts a failure
                    (serving/generation/scheduler.py)
+  ``kv_oom``       the paged KV pool reports exhaustion on one
+                   allocation: at admission the request stays QUEUED
+                   (backpressure); mid-stream the stream retires with
+                   a terminal ``kv_oom`` reply and a flight dump
+                   carrying the pool gauges — never a truncation
+                   (serving/generation/kv_cache.py)
   ``device_loss``  a pod participant stops heartbeating at step ``at``
                    and hangs — peers must detect the loss and trip
                    recovery instead of waiting on a dead collective
@@ -94,7 +100,8 @@ __all__ = ['configure', 'reset', 'any_active', 'active', 'fire', 'fire_in',
 SITES = ('ckpt_write', 'ckpt_io', 'cache_read', 'cache_write', 'io_read',
          'io_write', 'nan_step', 'prefetch_stall', 'feed_read', 'sigterm',
          'serve_dispatch', 'serve_slow_batch', 'queue_overflow',
-         'compile_storm', 'decode_step', 'device_loss', 'host_desync')
+         'compile_storm', 'decode_step', 'device_loss', 'host_desync',
+         'kv_oom')
 
 
 class InjectedFault(OSError):

@@ -78,10 +78,27 @@ def test_slot_allocator_rejects_bad_frees():
 
 def test_cache_config_geometry():
     c = CacheConfig(slots=4, layers=2, kv_heads=2, max_len=32, head_dim=8)
-    assert c.page_shape == (4, 2, 2, 32, 8)
-    assert c.bytes() == 2 * 4 * np.prod(c.page_shape)
+    assert c.page_len == 8 and c.max_pages == 4
+    assert c.pages == 4 * 4 + 1              # dense-equivalent + garbage
+    assert c.pool_shape == (c.pages, 2, 2, 8, 8)
+    assert c.bytes() == c.pages * c.page_bytes()
+    assert c.page_bytes() == 2 * 4 * 2 * 2 * 8 * 8
+    assert (c.pages_for(0), c.pages_for(1), c.pages_for(8),
+            c.pages_for(9)) == (0, 1, 1, 2)
+    assert c.dense_slot_bytes() == 2 * 4 * 2 * 2 * 32 * 8
+    q = CacheConfig(slots=4, layers=2, kv_heads=2, max_len=32, head_dim=8,
+                    page_len=4, quant='int8')
+    assert q.store_dtype == 'int8'
+    # int8 K+V page + f32 per-row scales
+    assert q.page_bytes() == 2 * (2 * 2 * 4 * 8) + 2 * 4 * (2 * 2 * 4)
     with pytest.raises(ValueError):
         CacheConfig(slots=0, layers=1, kv_heads=1, max_len=8, head_dim=4)
+    with pytest.raises(ValueError):
+        CacheConfig(slots=1, layers=1, kv_heads=1, max_len=8, head_dim=4,
+                    page_len=3)              # must divide max_len
+    with pytest.raises(ValueError):
+        CacheConfig(slots=1, layers=1, kv_heads=1, max_len=8, head_dim=4,
+                    quant='int4')
 
 
 # -------------------------------------------------------------- sampling
@@ -154,6 +171,7 @@ def test_chunked_prefill_matches_dense_reference():
     rt = _runtime(chunk=4)
     prompt = np.asarray(PROMPT, np.int32)
     slot = rt.alloc_slot()
+    assert rt.ensure_capacity(slot, prompt.size)   # map pages for the slot
     p = SamplingParams()
     logits = None
     for off in range(0, prompt.size, rt.prefill_chunk):
@@ -176,6 +194,7 @@ def test_ring_prefill_matches_dense_reference():
     rt = _runtime(slots=2, chunk=4, mesh=mesh)
     prompt = (np.arange(1, 11) % 63).astype(np.int32)   # pads 10 -> 12
     slot = rt.alloc_slot()
+    assert rt.ensure_capacity(slot, prompt.size)   # map pages for the slot
     first, logits = rt.prefill_ring(slot, prompt, SamplingParams())
     kref, vref, lref = dense_reference(rt.w, CFG, prompt)
     krow, vrow, length = rt.cache_row(slot)

@@ -507,25 +507,34 @@ rm -rf "$flight_dir"
 
 echo "== ci_smoke: decode soak (streaming generation under chaos) =="
 # generation gate (docs/generation.md): serve_soak --scenario decode
-# drives a GenerationEngine — slotted KV cache, chunked prefill
-# interleaved with fused decode windows, per-token streaming — with
-# open-loop traffic of mixed prompt lengths, mid-soak cancellations,
-# periodic overlong prompts (must be REFUSED, never truncated), and a
-# decode_step fault that must turn into clean error replies while the
-# engine keeps serving.  --assert-slo fails the gate unless the
-# accounting identity holds (terminal == admitted), serving.deadlocks
-# == 0, TTFT/ITL histograms are populated, at least one mixed
-# prefill+decode dispatch happened, zero compiles landed after warmup
-# (the fused window executables are closed over batch composition),
-# and every KV slot is back on the free list after drain.  PT_CACHE=1
-# so the decode/prefill executables round-trip the persistent AOT
+# drives a GenerationEngine over the PAGED KV pool with every density
+# multiplier armed — int8-quantized pages (PT_KV_QUANT), shared-prefix
+# caching (the prompts open with one full shared page), speculative
+# draft/verify decoding — with open-loop traffic of mixed prompt
+# lengths, mid-soak cancellations, periodic overlong prompts (must be
+# REFUSED, never truncated), and a decode_step fault that must turn
+# into clean error replies while the engine keeps serving.
+# --assert-slo fails the gate unless the accounting identity holds
+# (terminal == admitted), serving.deadlocks == 0, TTFT/ITL histograms
+# are populated, at least one mixed prefill+decode dispatch happened,
+# zero compiles landed after warmup (the fused window executables are
+# closed over page GEOMETRY, never per-request block tables), the
+# prefix cache actually hit (prefix_hits > 0), speculation actually
+# accepted tokens (spec_accepted > 0), and every KV slot AND page is
+# back on the free list after drain.  --capacity-floor then reruns a
+# fixed 16 KiB page budget with an oversubscribed slot table: excess
+# streams must queue at admission backpressure (never die mid-stream
+# as kv_oom) while >= 8 concurrent streams hold SLO — 4x what the
+# dense PR-11 layout fits in the same bytes.  PT_CACHE=1 so the
+# decode/prefill/verify executables round-trip the persistent AOT
 # cache on repeat runs.
 decode_cache=$(mktemp -d /tmp/pt_decode_cache.XXXXXX)
 timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=1 \
     PT_CACHE_DIR="$decode_cache" \
-    PT_FAULT="decode_step:at=3" \
+    PT_FAULT="decode_step:at=3" PT_KV_QUANT=int8 \
     python tools/serve_soak.py --scenario decode --requests 40 --qps 60 \
-    --assert-slo
+    --assert-slo --speculative --page-len 4 --kv-quant int8 \
+    --capacity-floor 8
 decode_rc=$?
 if [ "$decode_rc" -ne 0 ]; then
     echo "ci_smoke: decode soak FAILED (rc=$decode_rc)"

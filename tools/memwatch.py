@@ -11,7 +11,12 @@ keeps asking:
   * what does checkpointing hold on the HOST —
     ``ckpt.snapshot_host_bytes`` per snapshot (forced device->host
     copies pinned until the async writer drains) against the process
-    high-water RSS.
+    high-water RSS;
+  * (``--decode``) what does the paged KV pool of the streaming decode
+    runtime reserve vs actually pin — ``generation.kv_bytes_reserved``
+    (the fixed pool footprint) against ``generation.kv_bytes_live`` /
+    ``kv_pages_in_use`` sampled while streams run, the serving-density
+    counterpart of the HBM gauges (docs/generation.md).
 
 Runs a small fused training loop (the same shape bench.py uses) with
 periodic checkpoints, sampling after every launch, and prints one JSON
@@ -29,6 +34,60 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _decode_report(args):
+    """Run a few streams through a small paged DecodeRuntime and sample
+    the KV pool gauges: reserved (fixed) vs live (pages in use) bytes —
+    the number the serving-density work optimizes."""
+    import paddle_tpu.observability as obs
+    from paddle_tpu.serving.generation import (DecodeRuntime,
+                                               SamplingParams,
+                                               random_weights)
+    cfg = dict(vocab=128, d_model=32, n_layer=2, n_head=4, n_kv_head=2,
+               d_ffn=64, theta=10000.0, max_len=32)
+    rt = DecodeRuntime(random_weights(cfg, seed=0), cfg, slots=4,
+                       prefill_chunk=4, kv_quant=args.kv_quant)
+    rt.warmup(steps=4)
+
+    def kv_gauges():
+        g = obs.metrics_snapshot().get('gauges', {})
+        return {k: g.get('generation.' + k)
+                for k in ('kv_bytes_reserved', 'kv_bytes_live',
+                          'kv_pages_in_use', 'kv_slots_in_use')}
+
+    peak = {}
+    slots = [rt.alloc_slot() for _ in range(rt.slots)]
+    try:
+        for i, slot in enumerate(slots):
+            prompt = [1 + i, 5, 9, 2, 7, 3]
+            start = rt.try_begin(slot, prompt, 4)
+            for off in range(start, len(prompt), rt.prefill_chunk):
+                rt.prefill(slot, prompt[off:off + rt.prefill_chunk], off,
+                           SamplingParams(seed=i))
+        import numpy as np
+        active = np.ones(rt.slots, bool)
+        zeros = np.zeros(rt.slots, np.int32)
+        for _ in range(4):
+            ok = all(rt.ensure_capacity(s, int(rt.host_len[s]) + 4)
+                     for s in slots)
+            if not ok:
+                break
+            rt.decode_window(4, active, zeros, zeros.astype(np.float32),
+                             zeros)
+        peak = kv_gauges()
+    finally:
+        for slot in slots:
+            rt.free_slot(slot)
+        if rt.prefix is not None:
+            rt.prefix.reset()
+    drained = kv_gauges()
+    return {'quant': rt.cache.quant,
+            'page_len': rt.cache.page_len,
+            'page_bytes': rt.cache.page_bytes(),
+            'dense_slot_bytes': rt.cache.dense_slot_bytes(),
+            'peak': peak, 'drained': drained,
+            'pages_leaked': rt.pool.in_use()}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--steps', type=int, default=32)
@@ -37,6 +96,12 @@ def main():
     ap.add_argument('--hidden', type=int, default=64)
     ap.add_argument('--ckpt-interval', type=int, default=8,
                     help='checkpoint every N steps (0 disables)')
+    ap.add_argument('--decode', action='store_true',
+                    help='also run a small paged decode workload and '
+                         'report the KV pool gauges')
+    ap.add_argument('--kv-quant', default=None, choices=['none', 'int8'],
+                    help='KV quantization for the --decode workload '
+                         '(default: env PT_KV_QUANT)')
     args = ap.parse_args()
 
     import numpy as np
@@ -117,6 +182,8 @@ def main():
         report['note'] = ('backend reports no memory_stats() (CPU): HBM '
                           'gauges are absent by design; live_buffers and '
                           'host accounting above are still real')
+    if args.decode:
+        report['kv'] = _decode_report(args)
     print(json.dumps(report))
     # a leak check cheap enough to always run: the live-buffer count at
     # the end of a steady-state loop should not have grown unboundedly

@@ -253,47 +253,84 @@ def scenario_train_resnet(best_of):
 
 
 def scenario_decode_stream(best_of):
-    """Streaming generation through the GenerationEngine: open-loop
-    token-stream load, tokens/s/chip from the generation.tokens counter,
-    TTFT/ITL p99 from the serving histograms — the lab's view of
-    ROADMAP item 4's capacity claims."""
+    """Streaming generation through the GenerationEngine over the PAGED
+    KV pool: open-loop token-stream load under a FIXED page-budget
+    (int8-quantized pages, shared-prefix caching, speculative decode
+    all on), tokens/s/chip from the generation.tokens counter, TTFT/ITL
+    p99 from the serving histograms, and the serving-density headline —
+    peak concurrent streams the budget sustained at held SLOs
+    (``streams_at_slo``) against the streams a dense PR-11 layout
+    could have reserved in the same bytes (``density_x_vs_dense``)."""
+    import threading
+
     import numpy as np
     import paddle_tpu.observability as obs
     from paddle_tpu.serving.engine import ServingConfig
-    from paddle_tpu.serving.generation import (DecodeRuntime,
+    from paddle_tpu.serving.generation import (CacheConfig, DecodeRuntime,
                                                GenerationConfig,
                                                GenerationEngine)
     from paddle_tpu.serving.generation.decode import random_weights
 
     requests = _env_int('PERFLAB_DECODE_REQUESTS', 24)
-    slots = _env_int('PERFLAB_DECODE_SLOTS', 4)
+    slots = _env_int('PERFLAB_DECODE_SLOTS', 10)
     K = _env_int('PERFLAB_DECODE_WINDOW', 4)
+    budget = _env_int('PERFLAB_DECODE_KV_BUDGET', 16384)
+    page_len = _env_int('PERFLAB_DECODE_PAGE_LEN', 4)
+    quant = os.environ.get('PERFLAB_DECODE_KV_QUANT', 'int8')
 
     _harness.stage('build')
     cfg = dict(vocab=128, d_model=32, n_layer=2, n_head=4, n_kv_head=2,
                d_ffn=64, theta=10000.0, max_len=32)
     w = random_weights(cfg, seed=0)
-    rt = DecodeRuntime(w, cfg, slots=slots, prefill_chunk=4)
+    geom = CacheConfig(slots=slots, layers=cfg['n_layer'],
+                       kv_heads=cfg['n_kv_head'], max_len=cfg['max_len'],
+                       head_dim=cfg['d_model'] // cfg['n_head'],
+                       page_len=page_len, quant=quant)
+    # fixed byte budget -> pool depth; the same budget under the dense
+    # PR-11 layout (one f32 max_len strip per stream) is the density
+    # denominator
+    pages = max(2, budget // geom.page_bytes() + 1)   # +1: garbage page
+    dense_streams = max(1, budget // geom.dense_slot_bytes())
+    rt = DecodeRuntime(w, cfg, slots=slots, prefill_chunk=4,
+                       page_len=page_len, pages=pages, kv_quant=quant,
+                       prefix_cache=True)
     engine = GenerationEngine(
         rt, config=ServingConfig(max_queue=max(64, 2 * requests),
                                  drain_timeout_s=60.0),
-        gen_config=GenerationConfig(decode_window=K)).start()
+        gen_config=GenerationConfig(decode_window=K,
+                                    speculative=True)).start()
     _harness.stage('warmup')
-    rt.warmup(steps=K)
+    rt.warmup(steps=K, speculative=True)
     engine.generate([3, 1, 4, 1, 5], max_new=4).result(120)
     c0 = obs.counters()
     compiles0 = int(c0.get('generation.compiles') or 0)
     tokens0 = int(c0.get('generation.tokens') or 0)
 
     _harness.stage('measure')
-    lengths = (2, 5, 9, 14, 20)
+    # every prompt shares one FULL page of system prefix (prefix-cache
+    # hits after the first stream publishes it) plus a distinct tail;
+    # per-stream page demand stays within slots * worst-case even with
+    # zero sharing, so the budget never kills a stream mid-flight
+    shared = [(3 + j) % (cfg['vocab'] - 1) + 1 for j in range(page_len)]
+    tails = (1, 2, 3)
+    peak = [0]
+    done = threading.Event()
+
+    def poll_peak():
+        while not done.is_set():
+            peak[0] = max(peak[0], rt.allocator.in_use())
+            time.sleep(0.001)
+
+    poller = threading.Thread(target=poll_peak, daemon=True)
+    poller.start()
     t0 = time.perf_counter()
     streams = []
     for i in range(requests):
-        n = lengths[i % len(lengths)]
-        prompt = [(7 * i + j) % (cfg['vocab'] - 1) + 1 for j in range(n)]
+        n = tails[i % len(tails)]
+        prompt = shared + [(7 * i + j) % (cfg['vocab'] - 1) + 1
+                           for j in range(n)]
         streams.append(engine.generate(
-            prompt, max_new=min(8, cfg['max_len'] - n - 1),
+            prompt, max_new=6,
             temperature=0.8 if i % 3 else 0.0,
             top_k=5 if i % 3 else 0, seed=i, timeout_s=120.0))
     ok = failed = 0
@@ -305,6 +342,8 @@ def scenario_decode_stream(best_of):
         except Exception:
             failed += 1
     dt = time.perf_counter() - t0
+    done.set()
+    poller.join(1.0)
     engine.stop()
 
     _harness.stage('audit')
@@ -312,6 +351,14 @@ def scenario_decode_stream(best_of):
     tel = obs.telemetry_snapshot('serving')
     new_tokens = int(c1.get('generation.tokens') or 0) - tokens0
     tps = new_tokens / dt if dt > 0 else 0.0
+    if rt.prefix is not None:
+        rt.prefix.reset()          # cached pages are holds, not leaks
+    pages_leaked = int(rt.pool.in_use())
+    slots_leaked = int(rt.slots - rt.free_slots())
+    slo_held = (failed == 0 and ok == requests
+                and int(tel['deadlocks']) == 0 and slots_leaked == 0
+                and pages_leaked == 0)
+    streams_at_slo = int(peak[0]) if slo_held else 0
 
     def fin(v):
         return float(v) if v is not None and np.isfinite(v) else None
@@ -320,8 +367,11 @@ def scenario_decode_stream(best_of):
         'compiles_after_warmup': int(c1.get('generation.compiles') or 0) -
         compiles0,
         'deadlocks': int(tel['deadlocks']),
-        'kv_slots_leaked': int(rt.slots - rt.free_slots()),
+        'kv_slots_leaked': slots_leaked,
+        'kv_pages_leaked': pages_leaked,
         'streams_failed': failed,
+        'streams_at_slo': streams_at_slo,
+        'density_x_vs_dense': streams_at_slo // dense_streams,
         'tokens_per_s_per_chip': round(tps, 1),
         'ttft_p99_ms': fin(tel['ttft_p99_ms']),
         'itl_p99_ms': fin(tel['itl_p99_ms']),
@@ -329,7 +379,10 @@ def scenario_decode_stream(best_of):
         'streams_ok': ok,
     }
     config = {'requests': requests, 'slots': slots, 'decode_window': K,
-              'model': cfg}
+              'model': cfg, 'page_len': page_len, 'pages': pages,
+              'kv_quant': quant, 'kv_budget_bytes': budget,
+              'dense_streams_in_budget': dense_streams,
+              'speculative': True, 'prefix_cache': True}
     # one open-loop pass is the sample — TTFT/ITL p99 already aggregate
     # per-token noise, and re-running would double-count warm KV state
     return metrics, {'tokens_per_s_per_chip': [round(tps, 1)]}, config

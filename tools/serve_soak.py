@@ -49,11 +49,18 @@ Observability gates (docs/observability.md):
     usable postmortem).
 
 ``--scenario decode`` switches to the streaming-generation soak
-(`run_decode_scenario`): open-loop token-stream load with mixed prompt
-lengths, mid-soak cancellations, overlong-prompt refusals, the
-``decode_step`` fault site, and token-level SLO gates (TTFT/ITL
-histograms, bitwise greedy parity, zero post-warmup compiles, no KV
-slot leaks) — see docs/generation.md.
+(`run_decode_scenario`): open-loop token-stream load over the PAGED KV
+pool with mixed prompt lengths, mid-soak cancellations, overlong-prompt
+refusals, the ``decode_step`` fault site, and token-level SLO gates
+(TTFT/ITL histograms, bitwise greedy parity over the same page
+geometry/quantization, zero post-warmup compiles, no KV slot OR page
+leaks, prefix-cache hits when shared prompts flow, live draft/verify
+acceptance when --speculative) — see docs/generation.md.
+``--capacity-floor N`` appends the fixed-budget density gate
+(`run_capacity_gate`): a hard KV byte budget, an oversubscribed slot
+table, and a stream ramp that must queue at admission backpressure —
+never die mid-stream — while sustaining >= N concurrent streams at SLO
+(ledgered as the ``decode_capacity`` scenario).
 
 Prints one JSON line with the verdict and the metrics that prove it
 (the serving block comes from observability.telemetry_snapshot, the
@@ -138,17 +145,29 @@ def run_decode_scenario(args):
     cfg = dict(vocab=128, d_model=32, n_layer=2, n_head=4, n_kv_head=2,
                d_ffn=64, theta=10000.0, max_len=32)
     w = random_weights(cfg, seed=0)
-    rt = DecodeRuntime(w, cfg, slots=args.slots, prefill_chunk=4)
+    rt = DecodeRuntime(w, cfg, slots=args.slots, prefill_chunk=4,
+                       page_len=args.page_len, pages=args.pages,
+                       kv_quant=args.kv_quant)
     K = args.decode_window
     engine = GenerationEngine(
         rt, config=ServingConfig(max_queue=args.max_queue,
                                  drain_timeout_s=30.0),
-        gen_config=GenerationConfig(decode_window=K)).start()
+        gen_config=GenerationConfig(
+            decode_window=K,
+            speculative=True if args.speculative else None)).start()
 
     # parity gate first (its executables land before the warmup
-    # snapshot): fused engine stream == sequential K=1 reference
+    # snapshot): fused engine stream == sequential K=1 reference over
+    # the SAME page geometry and quantization (speculative decode, if
+    # on, must also be bitwise-invisible here).  The PT_FAULT matrix is
+    # disarmed for this pre-flight — fault fire counts (at=N) index
+    # into SOAK traffic rounds, not the parity probe — and re-armed
+    # from the environment before traffic starts
+    from paddle_tpu.testing import faults as _faults
+    _faults.configure('')
     ref_prompt = [3, 1, 4, 1, 5, 9, 2, 6]
-    ref_rt = DecodeRuntime(w, cfg, slots=1, prefill_chunk=4)
+    ref_rt = DecodeRuntime(w, cfg, slots=1, prefill_chunk=4,
+                           page_len=args.page_len, kv_quant=args.kv_quant)
     ref = ref_rt.generate(ref_prompt, 8, steps_per_window=1)
     got = engine.generate(ref_prompt, max_new=8).result(60)
     if not got.ok or list(got.outputs[0]) != ref:
@@ -156,7 +175,8 @@ def run_decode_scenario(args):
                  'sequential=%r'
                  % (list(got.outputs[0]) if got.ok else got.status, ref))
 
-    rt.warmup(steps=K)
+    rt.warmup(steps=K, speculative=args.speculative)
+    _faults.configure()
     compiles0 = obs.counters().get('generation.compiles') or 0
 
     _harness.stage('decode_traffic')
@@ -164,14 +184,19 @@ def run_decode_scenario(args):
     overlong = 0
     period = 1.0 / args.qps if args.qps > 0 else 0.0
     lengths = (2, 5, 9, 14, 20)
+    # every well-formed prompt opens with one full page of shared
+    # "system prefix" so the prefix cache has something real to hit
+    shared = ([(3 + j) % (cfg['vocab'] - 1) + 1
+               for j in range(rt.cache.page_len)]
+              if rt.prefix is not None else [])
     for i in range(args.requests):
         if i % 11 == 10:
             prompt = list(range(1, 40))        # must be REFUSED, whole
             overlong += 1
         else:
             n = lengths[i % len(lengths)]
-            prompt = [(7 * i + j) % (cfg['vocab'] - 1) + 1
-                      for j in range(n)]
+            prompt = shared + [(7 * i + j) % (cfg['vocab'] - 1) + 1
+                               for j in range(n)]
         s = engine.generate(prompt,
                             max_new=min(8, cfg['max_len'] - min(
                                 len(prompt), cfg['max_len'] - 1)),
@@ -210,6 +235,9 @@ def run_decode_scenario(args):
     tel = obs.telemetry_snapshot('serving')
     c = obs.counters()
     compiles_during = (c.get('generation.compiles') or 0) - compiles0
+    if rt.prefix is not None:
+        rt.prefix.reset()          # cached pages are holds, not leaks
+    pages_leaked = int(rt.pool.in_use())
     rec = {
         'scenario': 'decode',
         'requests_submitted': len(streams),
@@ -221,6 +249,13 @@ def run_decode_scenario(args):
         'mixed_dispatches': int(c.get('generation.mixed_dispatches') or 0),
         'tokens': int(c.get('generation.tokens') or 0),
         'free_slots': rt.free_slots(),
+        'kv_pages_leaked': pages_leaked,
+        'prefix_hits': int(c.get('generation.prefix_hits') or 0),
+        'spec_proposed': int(c.get('generation.spec_proposed') or 0),
+        'spec_accepted': int(c.get('generation.spec_accepted') or 0),
+        'kv_backpressure': int(c.get('generation.kv_backpressure') or 0),
+        'kv_oom': int(c.get('generation.kv_oom') or 0),
+        'kv_pool': rt.pool_snapshot(),
         'state': engine.state,
     }
     rec.update(tel)
@@ -269,9 +304,142 @@ def run_decode_scenario(args):
         if rec['free_slots'] != rt.slots:
             sys.exit('serve_soak[decode]: %d/%d KV slots leaked'
                      % (rt.slots - rec['free_slots'], rt.slots))
+        if pages_leaked:
+            sys.exit('serve_soak[decode]: %d KV pages still allocated '
+                     'after drain (post prefix-cache reset)'
+                     % pages_leaked)
+        if rt.prefix is not None and rec['prefix_hits'] < 1:
+            sys.exit('serve_soak[decode]: shared-prefix prompts produced '
+                     'no prefix-cache hits')
+        if args.speculative and (rec['spec_proposed'] < 1
+                                 or rec['spec_accepted'] < 1):
+            sys.exit('serve_soak[decode]: speculative decode proposed=%d '
+                     'accepted=%d — draft/verify pipeline inert'
+                     % (rec['spec_proposed'], rec['spec_accepted']))
         if rec['state'] != 'stopped':
             sys.exit('serve_soak[decode]: engine did not reach STOPPED '
                      '(state=%s)' % rec['state'])
+    if args.capacity_floor:
+        return run_capacity_gate(args, w, cfg)
+    return 0
+
+
+def run_capacity_gate(args, w, cfg):
+    """Fixed-budget serving-density gate (--capacity-floor N): size the
+    page pool to a hard byte budget, oversubscribe the slot table, and
+    ram the engine with more streams than the pages can hold at once.
+    The excess must queue at ADMISSION (generation.kv_backpressure > 0)
+    — never die mid-stream with kv_oom — every stream must still finish
+    OK, and the peak concurrency the budget sustained must beat the
+    floor.  With int8 pages the floor is set at >= 4x the streams a
+    dense PR-11 layout (one f32 max_len strip each) could reserve in
+    the same bytes.  The verdict is ledgered as ``decode_capacity``.
+
+    ``max_new = decode_window + 1`` keeps every stream inside its
+    admission-time page span (one prefill token plus exactly one fused
+    window), so admission is provably the only pressure path."""
+    import numpy as np  # noqa: F401 - parity with sibling scenarios
+    import paddle_tpu.observability as obs
+    from paddle_tpu.serving.engine import ServingConfig
+    from paddle_tpu.serving.generation import (CacheConfig, DecodeRuntime,
+                                               GenerationConfig,
+                                               GenerationEngine)
+    from paddle_tpu.testing import faults as _faults
+
+    _harness.stage('decode_capacity')
+    _faults.configure('')   # density measurement, not chaos: run clean
+    K = args.decode_window
+    quant = args.kv_quant or 'int8'
+    page_len = args.page_len or 4
+    geom = CacheConfig(slots=1, layers=cfg['n_layer'],
+                       kv_heads=cfg['n_kv_head'], max_len=cfg['max_len'],
+                       head_dim=cfg['d_model'] // cfg['n_head'],
+                       page_len=page_len, quant=quant)
+    budget = args.capacity_budget
+    pages = max(2, budget // geom.page_bytes() + 1)   # +1: garbage page
+    dense_streams = max(1, budget // geom.dense_slot_bytes())
+    # oversubscribed slot table: pages, not slots, must bind admission;
+    # prefix cache off so every stream has identical page demand
+    slots = 16
+    rt = DecodeRuntime(w, cfg, slots=slots, prefill_chunk=4,
+                       page_len=page_len, pages=pages, kv_quant=quant,
+                       prefix_cache=False)
+    engine = GenerationEngine(
+        rt, config=ServingConfig(max_queue=256, drain_timeout_s=60.0),
+        gen_config=GenerationConfig(decode_window=K,
+                                    speculative=False)).start()
+    rt.warmup(steps=K)
+    bp0 = int(obs.counters().get('generation.kv_backpressure') or 0)
+
+    peak = [0]
+    done = threading.Event()
+
+    def poll():
+        while not done.is_set():
+            peak[0] = max(peak[0], rt.allocator.in_use())
+            time.sleep(0.001)
+
+    poller = threading.Thread(target=poll, daemon=True)
+    poller.start()
+    requests = 3 * slots
+    streams = []
+    for i in range(requests):
+        n = 1 + (i % 3)
+        prompt = ([(3 + j) % (cfg['vocab'] - 1) + 1
+                   for j in range(page_len)] +
+                  [(7 * i + j) % (cfg['vocab'] - 1) + 1 for j in range(n)])
+        streams.append(engine.generate(prompt, max_new=K + 1, seed=i,
+                                       timeout_s=120.0))
+    ok = 0
+    for s in streams:
+        try:
+            ok += 1 if s.result(120).ok else 0
+        except Exception:
+            pass
+    done.set()
+    poller.join(1.0)
+    engine.stop()
+
+    backpressure = (int(obs.counters().get('generation.kv_backpressure')
+                        or 0) - bp0)
+    pages_leaked = int(rt.pool.in_use())
+    slo_held = (ok == requests and pages_leaked == 0
+                and rt.free_slots() == rt.slots)
+    streams_at_slo = int(peak[0]) if slo_held else 0
+    floor = args.capacity_floor
+    rec = {'scenario': 'decode_capacity', 'requests': requests,
+           'streams_ok': ok, 'kv_budget_bytes': budget,
+           'page_len': page_len, 'kv_quant': quant, 'pages': pages,
+           'dense_streams_in_budget': dense_streams,
+           'kv_backpressure': backpressure,
+           'kv_pages_leaked': pages_leaked,
+           'streams_at_slo': streams_at_slo,
+           'density_x_vs_dense': streams_at_slo // dense_streams,
+           'capacity_floor': floor}
+    print(json.dumps(rec))
+    from paddle_tpu.observability import perflab
+    perflab.maybe_ledger(
+        'decode_capacity',
+        {'streams_at_slo': streams_at_slo,
+         'kv_pages_leaked': pages_leaked,
+         'density_x_vs_dense': rec['density_x_vs_dense'],
+         'capacity_floor': floor, 'kv_budget_bytes': budget,
+         'page_len': page_len, 'kv_quant': quant})
+    if ok != requests:
+        sys.exit('serve_soak[capacity]: %d/%d streams failed under the '
+                 'page budget — backpressure must queue, never kill'
+                 % (requests - ok, requests))
+    if backpressure < 1:
+        sys.exit('serve_soak[capacity]: the ramp never hit admission '
+                 'backpressure — the budget was not binding, density '
+                 'unproven')
+    if pages_leaked:
+        sys.exit('serve_soak[capacity]: %d KV pages still allocated '
+                 'after drain' % pages_leaked)
+    if streams_at_slo < floor:
+        sys.exit('serve_soak[capacity]: %d concurrent streams at SLO '
+                 'under a %d-byte budget — floor is %d'
+                 % (streams_at_slo, budget, floor))
     return 0
 
 
@@ -320,6 +488,23 @@ def main():
     ap.add_argument('--cancel-every', type=int, default=7,
                     help='[decode] cancel every Nth stream after its '
                          'first token (0 = never)')
+    ap.add_argument('--kv-quant', default=None, choices=('none', 'int8'),
+                    help='[decode] KV page quantization (default: env '
+                         'PT_KV_QUANT)')
+    ap.add_argument('--page-len', type=int, default=None,
+                    help='[decode] tokens per KV page (default: largest '
+                         'divisor of max_len that is <= 8)')
+    ap.add_argument('--pages', type=int, default=None,
+                    help='[decode] KV pool depth (default: enough for '
+                         'every slot at max_len)')
+    ap.add_argument('--speculative', action='store_true',
+                    help='[decode] draft+verify speculative decoding')
+    ap.add_argument('--capacity-floor', type=int, default=0,
+                    help='[decode] after the soak, run the fixed-budget '
+                         'capacity gate and require at least this many '
+                         'concurrent streams at SLO (0 = skip)')
+    ap.add_argument('--capacity-budget', type=int, default=16384,
+                    help='[decode] KV byte budget for the capacity gate')
     args = ap.parse_args()
     if args.scenario == 'decode':
         return run_decode_scenario(args)
