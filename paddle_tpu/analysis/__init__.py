@@ -11,7 +11,7 @@ core.  Three entry points share one engine:
   * the executor's PT_LINT hook  — strict|warn|0 at lowering-cache miss
                                    (core/executor.py _lower)
 
-See docs/analysis.md for the diagnostic code table (D001..D014) and
+See docs/analysis.md for the diagnostic code table (D001..D021) and
 severity semantics.
 """
 import os

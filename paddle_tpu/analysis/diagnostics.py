@@ -23,10 +23,16 @@ Code table (docs/analysis.md has the full semantics):
   D014 warning  degenerate learning-rate decay constant
   D015 info     op not emit-capable (direct emitter would fall back)
   D016 info     fused sub-op not kernelgen-capable (replay fallback)
+  D017 error    sharding conflict (producers force incompatible specs)
+  D018 warning  implicit reshard (consumed spec differs from delivered)
+  D019 error    mesh-axis mismatch (spec names an undeclared mesh axis)
+  D020 error    memplan over budget (static HBM footprint > device limit)
+  D021 warning  donation hazard (host array / param read after donation)
   D099 info     lint pass crashed (analyzer bug, never fatal)
 """
 
-__all__ = ['Diagnostic', 'LintResult', 'LintError', 'SEVERITIES', 'CODES']
+__all__ = ['Diagnostic', 'LintResult', 'LintError', 'SEVERITIES', 'CODES',
+           'DIAG_JSON_KEYS', 'RESULT_JSON_KEYS']
 
 SEVERITIES = ('info', 'warning', 'error')
 _SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
@@ -48,8 +54,22 @@ CODES = {
     'D014': 'degenerate lr decay',
     'D015': 'op not emit-capable',
     'D016': 'fused sub-op not kernelgen-capable',
+    'D017': 'sharding conflict',
+    'D018': 'implicit reshard',
+    'D019': 'mesh-axis mismatch',
+    'D020': 'memplan over device limit',
+    'D021': 'donation hazard',
     'D099': 'lint pass crashed',
 }
+
+# The JSON shapes `Diagnostic.to_dict` / `LintResult.to_dict` emit —
+# pinned as constants so tools (pt_lint --json consumers, the ci_smoke
+# schema gate) validate against the same source of truth the renderer
+# uses instead of a hand-copied list.
+DIAG_JSON_KEYS = ('code', 'severity', 'message', 'op_type', 'op_index',
+                  'block_idx', 'block_path', 'var', 'fixit', 'source_loc',
+                  'pass')
+RESULT_JSON_KEYS = ('diagnostics', 'errors', 'warnings', 'infos')
 
 
 class Diagnostic(object):

@@ -1,0 +1,80 @@
+"""D021: donation safety — the static form of the PR-6 heap bug.
+
+The executor donates the parameter dict to the lowered executable
+(donate_argnums=(0,)) whenever the program writes parameters back.
+Donation frees the INPUT buffer the moment the executable runs, which
+is only safe for buffers the runtime owns.  Two program shapes hand it
+buffers someone else still holds, and both are invisible to the dynamic
+D007 check (they are cross-launch, not in-block):
+
+  * host-owned array into a donating executable: a feed name that
+    shadows a written-back persistable routes the fed host ndarray into
+    the donated params slot — after the launch the scope entry aliases
+    freed memory (PR-6 corrupted the heap exactly here, at runtime;
+    docs/robustness.md tells the dynamic half of that story)
+  * param read after donation across fused `run_steps` chains: fetching
+    a written-back Parameter hands the caller a handle into the donated
+    carry — the NEXT chained launch invalidates it under the reader
+
+Severity is warning (like D007/D008): the executor's copy-on-feed and
+sync paths mask many instances, but each one is a latent use-after-free
+that surfaces the day the masking path changes.
+"""
+from ...core.framework import Parameter
+from ..engine import register_pass
+
+__all__ = ['run']
+
+
+def _written_persistables(ctx, block):
+    """Persistable names written anywhere in `block` -> (op_index, op)
+    of the first writing op (the point donation is decided)."""
+    out = {}
+    for i, op in enumerate(block.ops):
+        for n in op.output_names():
+            v = block._find_var_recursive(n)
+            if v is not None and (v.persistable or
+                                  isinstance(v, Parameter)):
+                out.setdefault(n, (i, op))
+    return out
+
+
+@register_pass('donation')
+def run(ctx):
+    diags = []
+    root = ctx.program.global_block()
+    written = _written_persistables(ctx, root)
+    if not written:
+        return diags  # no writeback -> executor never donates
+
+    for n in ctx.feed_names:
+        if n in written:
+            w_i, w_op = written[n]
+            diags.append(ctx.diag(
+                'D021', 'warning',
+                'host-owned feed "%s" reaches a donating executable: the '
+                'program writes it back (op#%d "%s"), so the executor '
+                'donates the params dict and the fed host array\'s '
+                'buffer is freed under the caller after the launch'
+                % (n, w_i, w_op.type),
+                block=root, op=w_op, op_index=w_i, var=n,
+                fixit='device_put the array into the scope instead of '
+                      'feeding it, or rename the feed',
+                pass_name='donation'))
+
+    for n in ctx.fetch_names:
+        v = root._find_var_recursive(n)
+        if isinstance(v, Parameter) and n in written:
+            w_i, w_op = written[n]
+            diags.append(ctx.diag(
+                'D021', 'warning',
+                'parameter "%s" is both written back (op#%d "%s") and '
+                'fetched: under donation the fetched handle aliases the '
+                'scan carry, and the next chained run_steps launch '
+                'invalidates it while the caller still reads it'
+                % (n, w_i, w_op.type),
+                block=root, op=w_op, op_index=w_i, var=n,
+                fixit='fetch a copy (assign to a fresh var) instead of '
+                      'the parameter itself',
+                pass_name='donation'))
+    return diags

@@ -1,0 +1,251 @@
+"""D020: static per-device HBM planning.
+
+Folds every statically-knowable byte a program will pin into one
+per-device footprint — params, optimizer accumulators (persistable
+non-parameter state), the liveness peak of forward/backward activations
+(reusing the walker's read-attribution machinery), and the serving
+KV-cache pool declared via `Program.set_kv_plan` (PR-18's
+`CacheConfig.bytes()` arithmetic, paged/quantized aware) — and emits
+D020 when it exceeds the per-device limit, BEFORE any tracing happens.
+The Julia→TPU full-compilation work (arxiv 1810.09868) is the shape
+argument here: whole-program memory knowledge belongs in the IR, not
+reconstructed from an OOM at lowering time.
+
+Per-var bytes divide by the sharding divisor (product of declared mesh
+sizes over the var's spec axes), so a model-parallel annotation shrinks
+the plan the way it shrinks the real footprint.  Batch dims (-1) count
+via the `batch` knob (default 1 — a lower bound, the honest direction).
+
+The limit comes from `Program.set_device_limit(bytes)`; with none
+declared the pass asks the runtime (`memory_stats()['bytes_limit']`,
+absent on CPU) and stays quiet when neither exists.
+
+`plan_memory()` is also a public API: `pt_lint --memplan` renders its
+table, JSON consumers get `MEMPLAN_JSON_KEYS`-shaped dicts.
+"""
+from ...core.framework import Parameter
+from ...core.passes.walker import block_last_reads, persistable_names
+from ...core.sharding import spec_divisor
+from ..engine import register_pass
+
+__all__ = ['run', 'plan_memory', 'MemPlan', 'MEMPLAN_JSON_KEYS']
+
+MEMPLAN_JSON_KEYS = ('params_bytes', 'opt_state_bytes',
+                     'activation_peak_bytes', 'kv_pool_bytes',
+                     'total_bytes', 'limit_bytes', 'limit_source',
+                     'peak_op', 'top', 'mesh_axes', 'batch')
+
+
+def _var_bytes(v, mesh, batch):
+    if v is None or v.shape is None:
+        return 0
+    n = 1
+    for d in v.shape:
+        n *= batch if d in (-1, None) else int(d)
+    try:
+        itemsize = v.np_dtype.itemsize
+    except Exception:
+        itemsize = 4
+    return (n * itemsize) // spec_divisor(v._sharding_spec, mesh)
+
+
+def _fmt_bytes(b):
+    for unit in ('B', 'KiB', 'MiB', 'GiB'):
+        if abs(b) < 1024 or unit == 'GiB':
+            return ('%d %s' % (b, unit)) if unit == 'B' else \
+                ('%.2f %s' % (b, unit))
+        b /= 1024.0
+    return '%d B' % b
+
+
+class MemPlan(object):
+    """One program's static per-device memory plan."""
+
+    def __init__(self, params_bytes, opt_state_bytes,
+                 activation_peak_bytes, kv_pool_bytes, limit_bytes,
+                 limit_source, peak_op, top, mesh_axes, batch):
+        self.params_bytes = params_bytes
+        self.opt_state_bytes = opt_state_bytes
+        self.activation_peak_bytes = activation_peak_bytes
+        self.kv_pool_bytes = kv_pool_bytes
+        self.limit_bytes = limit_bytes
+        self.limit_source = limit_source
+        self.peak_op = peak_op        # (op_index, op_type) or None
+        self.top = top                # [(name, kind, bytes)] largest first
+        self.mesh_axes = mesh_axes
+        self.batch = batch
+
+    @property
+    def total_bytes(self):
+        return (self.params_bytes + self.opt_state_bytes +
+                self.activation_peak_bytes + self.kv_pool_bytes)
+
+    def over_limit(self):
+        return self.limit_bytes is not None and \
+            self.total_bytes > self.limit_bytes
+
+    def to_dict(self):
+        return {'params_bytes': self.params_bytes,
+                'opt_state_bytes': self.opt_state_bytes,
+                'activation_peak_bytes': self.activation_peak_bytes,
+                'kv_pool_bytes': self.kv_pool_bytes,
+                'total_bytes': self.total_bytes,
+                'limit_bytes': self.limit_bytes,
+                'limit_source': self.limit_source,
+                'peak_op': (list(self.peak_op) if self.peak_op else None),
+                'top': [[n, k, b] for n, k, b in self.top],
+                'mesh_axes': (dict(self.mesh_axes)
+                              if self.mesh_axes else None),
+                'batch': self.batch}
+
+    def render_table(self):
+        rows = [('params', self.params_bytes),
+                ('optimizer state', self.opt_state_bytes),
+                ('activation peak', self.activation_peak_bytes),
+                ('kv pool', self.kv_pool_bytes),
+                ('total', self.total_bytes)]
+        width = max(len(r[0]) for r in rows)
+        lines = ['memplan (per device, batch=%d%s):'
+                 % (self.batch,
+                    ', mesh=%s' % dict(self.mesh_axes)
+                    if self.mesh_axes else '')]
+        for name, b in rows:
+            lines.append('  %-*s  %12s' % (width, name, _fmt_bytes(b)))
+        if self.limit_bytes is not None:
+            lines.append('  %-*s  %12s  (%s)%s'
+                         % (width, 'limit', _fmt_bytes(self.limit_bytes),
+                            self.limit_source,
+                            '  ** OVER **' if self.over_limit() else ''))
+        if self.peak_op:
+            lines.append('  peak at op#%d %s' % tuple(self.peak_op))
+        for name, kind, b in self.top[:5]:
+            lines.append('    %-12s %-24s %12s' % (kind, name,
+                                                   _fmt_bytes(b)))
+        return '\n'.join(lines)
+
+    __repr__ = __str__ = lambda self: self.render_table()
+
+
+def _query_runtime_limit():
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            limit = stats.get('bytes_limit')
+            return int(limit) if limit else None
+    except Exception:
+        return None
+    return None
+
+
+def plan_memory(program, feed_names=(), fetch_names=(), batch=1):
+    """Build the static per-device MemPlan for `program`."""
+    mesh = program.mesh_axes()
+    root = program.global_block()
+    persist = persistable_names(program)
+    contrib = []  # (name, kind, bytes)
+
+    params_bytes = 0
+    opt_bytes = 0
+    for b in program.blocks:
+        for name, v in b.vars.items():
+            if isinstance(v, Parameter):
+                by = _var_bytes(v, mesh, batch)
+                params_bytes += by
+                contrib.append((name, 'param', by))
+            elif v.persistable and not getattr(v, 'is_data', False):
+                by = _var_bytes(v, mesh, batch)
+                opt_bytes += by
+                contrib.append((name, 'opt_state', by))
+
+    # activation liveness over the root block: a buffer is born at its
+    # producing op and dies after its last read (walker attribution);
+    # feeds live from op 0, fetches live to the end
+    last_read = block_last_reads(program, root)
+    n_ops = len(root.ops)
+    for n in fetch_names:
+        last_read[n] = n_ops
+    births = {}
+    for i, op in enumerate(root.ops):
+        for n in op.output_names():
+            births.setdefault(n, i)
+    for n in feed_names:
+        births[n] = 0
+    live = 0
+    peak = 0
+    peak_i = None
+    sizes = {}
+    deaths = {}
+    for n, i in births.items():
+        if n in persist:
+            continue  # persistables counted above, alive forever
+        v = root._find_var_recursive(n)
+        by = _var_bytes(v, mesh, batch)
+        if by <= 0:
+            continue
+        sizes[n] = by
+        deaths.setdefault(last_read.get(n, i), []).append(n)
+    for i in range(n_ops + 1):
+        for n, bi in births.items():
+            if bi == i and n in sizes:
+                live += sizes[n]
+        if live > peak:
+            peak = live
+            peak_i = i
+        for n in deaths.get(i, ()):
+            live -= sizes.pop(n, 0)
+    peak_op = None
+    if peak_i is not None and peak_i < n_ops:
+        peak_op = (peak_i, root.ops[peak_i].type)
+
+    kv_bytes = 0
+    if program._kv_plan:
+        try:
+            from ...serving.generation.kv_cache import CacheConfig
+            kv_bytes = int(CacheConfig(**program._kv_plan).bytes())
+        except Exception:
+            kv_bytes = 0
+
+    limit = program._device_limit_bytes
+    source = 'declared'
+    if limit is None:
+        limit = _query_runtime_limit()
+        source = 'runtime' if limit is not None else 'none'
+
+    contrib.sort(key=lambda t: -t[2])
+    return MemPlan(params_bytes, opt_bytes, peak, kv_bytes, limit, source,
+                   peak_op, contrib[:8], program._mesh_axes, batch)
+
+
+@register_pass('memplan')
+def run(ctx):
+    program = ctx.program
+    plan = plan_memory(program, feed_names=ctx.feed_names,
+                       fetch_names=ctx.fetch_names)
+    # stash for pt_lint --memplan so the CLI renders the same plan the
+    # pass judged, without a second walk
+    program._last_memplan = plan
+    if not plan.over_limit():
+        return []
+    root = program.global_block()
+    op = None
+    op_index = None
+    if plan.peak_op is not None:
+        op_index = plan.peak_op[0]
+        op = root.ops[op_index]
+    worst = ', '.join('%s %s (%s)' % (k, n, _fmt_bytes(b))
+                      for n, k, b in plan.top[:3])
+    return [ctx.diag(
+        'D020', 'error',
+        'static per-device footprint %s exceeds the %s limit %s '
+        '(params %s + opt state %s + activation peak %s + kv pool %s); '
+        'largest: %s'
+        % (_fmt_bytes(plan.total_bytes), plan.limit_source,
+           _fmt_bytes(plan.limit_bytes), _fmt_bytes(plan.params_bytes),
+           _fmt_bytes(plan.opt_state_bytes),
+           _fmt_bytes(plan.activation_peak_bytes),
+           _fmt_bytes(plan.kv_pool_bytes), worst),
+        block=root, op=op, op_index=op_index,
+        fixit='shard the largest contributors over the mesh, shrink the '
+              'kv plan, or raise the declared device limit',
+        pass_name='memplan')]

@@ -18,8 +18,7 @@ from . import async_runtime as _async
 from . import compile_cache as _cc
 from . import emit as _emit
 from . import passes as _passes
-from .framework import (Variable, default_main_program, TPUPlace,
-                        Program)
+from .framework import Variable, default_main_program, TPUPlace
 from .. import observability as _obs
 from ..testing import faults as _faults
 

@@ -182,13 +182,14 @@ class Variable(object):
         if name is None:
             name = unique_name.generate('_generated_var')
         self.name = name
-        self.shape = tuple(shape) if shape is not None else None
+        self._shape = tuple(shape) if shape is not None else None
         self._dtype = dtype_str(dtype) if dtype is not None else None
         self.lod_level = lod_level
-        self.persistable = persistable
-        self.stop_gradient = stop_gradient
+        self._persistable = persistable
+        self._stop_gradient = stop_gradient
         self.is_data = is_data
         self.type = type or 'lod_tensor'
+        self._sharding_spec = None  # canonical tuple spec (core/sharding.py)
         self.op = None  # producer op
         self._ivalue = None      # imperative mode: concrete jax.Array
         self._grad_value = None  # imperative mode: last computed gradient
@@ -221,6 +222,65 @@ class Variable(object):
 
     _clear_gradient = clear_gradient
 
+    # ------- mutation-tracked attributes --------------------------------
+    # In-place edits on an existing var (shape refinement, persistable
+    # flips, sharding annotations) must invalidate the executor lowering
+    # cache and the lint memo — both key on Program._version — so every
+    # setter bumps.  Construction writes the underscore storage directly.
+
+    def _bump_program(self):
+        blk = getattr(self, 'block', None)
+        if blk is not None:
+            blk.program._bump()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, s):
+        self._shape = tuple(s) if s is not None else None
+        self._bump_program()
+
+    @property
+    def persistable(self):
+        return self._persistable
+
+    @persistable.setter
+    def persistable(self, p):
+        self._persistable = p
+        self._bump_program()
+
+    @property
+    def stop_gradient(self):
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, s):
+        self._stop_gradient = s
+        self._bump_program()
+
+    @property
+    def sharding(self):
+        """Canonical sharding spec (tuple per core/sharding.py) or None.
+        Setting syncs Program._sharding (the executor's in_shardings
+        source) with the PartitionSpec view and bumps the version."""
+        return self._sharding_spec
+
+    @sharding.setter
+    def sharding(self, spec):
+        from .sharding import normalize_spec, to_partition_spec
+        spec = normalize_spec(spec)
+        self._sharding_spec = spec
+        blk = getattr(self, 'block', None)
+        if blk is not None:
+            prog = blk.program
+            if spec is None:
+                prog._sharding.pop(self.name, None)
+            else:
+                prog._sharding[self.name] = to_partition_spec(spec)
+            prog._bump()
+
     @property
     def dtype(self):
         return self._dtype
@@ -228,6 +288,7 @@ class Variable(object):
     @dtype.setter
     def dtype(self, v):
         self._dtype = dtype_str(v)
+        self._bump_program()
 
     @property
     def np_dtype(self):
@@ -413,6 +474,79 @@ def _capture_source_loc():
     return None
 
 
+class _AttrDict(dict):
+    """Operator.attrs wrapper: in-place mutation bumps the owning
+    program's version so the lowering cache and the lint memo (both
+    keyed on Program._version) never serve stale results.  No-op writes
+    (setdefault on a present key, re-setting an identical value) do NOT
+    bump, keeping versions stable across idempotent rewriter passes."""
+
+    __slots__ = ('_op',)
+
+    def __init__(self, data, op):
+        super(_AttrDict, self).__init__(data)
+        self._op = op
+
+    def _bump(self):
+        blk = getattr(self._op, 'block', None) if self._op is not None \
+            else None
+        if blk is not None:
+            blk.program._bump()
+
+    @staticmethod
+    def _same(a, b):
+        try:
+            return bool(a == b)
+        except Exception:       # ndarray-valued attrs and other oddballs
+            return False
+
+    def __setitem__(self, k, v):
+        if k in self and self._same(dict.__getitem__(self, k), v):
+            return
+        dict.__setitem__(self, k, v)
+        self._bump()
+
+    def __delitem__(self, k):
+        dict.__delitem__(self, k)
+        self._bump()
+
+    def setdefault(self, k, default=None):
+        if k in self:
+            return dict.__getitem__(self, k)
+        self[k] = default
+        return default
+
+    def update(self, *a, **kw):
+        for k, v in dict(*a, **kw).items():
+            self[k] = v
+
+    def pop(self, k, *default):
+        had = k in self
+        out = dict.pop(self, k, *default)
+        if had:
+            self._bump()
+        return out
+
+    def popitem(self):
+        out = dict.popitem(self)
+        self._bump()
+        return out
+
+    def clear(self):
+        if self:
+            dict.clear(self)
+            self._bump()
+
+    # deepcopy / pickle must NOT drag the op (and through it the whole
+    # program) along — clone() deep-copies attrs and re-wraps on assign
+    def __deepcopy__(self, memo):
+        return {copy.deepcopy(k, memo): copy.deepcopy(v, memo)
+                for k, v in self.items()}
+
+    def __reduce__(self):
+        return (dict, (dict(self),))
+
+
 class Operator(object):
     """One node in a Block: op type + named input/output slots + attrs.
 
@@ -447,6 +581,20 @@ class Operator(object):
             vs = vs if isinstance(vs, (list, tuple)) else [vs]
             self.outputs[slot] = [v.name if isinstance(v, Variable) else v
                                   for v in vs]
+
+    @property
+    def attrs(self):
+        return self._attrs
+
+    @attrs.setter
+    def attrs(self, d):
+        if isinstance(d, _AttrDict) and d._op is self:
+            self._attrs = d
+        else:
+            self._attrs = _AttrDict(dict(d or {}), self)
+        blk = getattr(self, 'block', None)
+        if blk is not None:
+            blk.program._bump()
 
     def input_names(self):
         return [n for vs in self.inputs.values() for n in vs]
@@ -661,6 +809,12 @@ class Program(object):
         self._is_test = False
         # sharding annotations attached by parallel/transpiler.py
         self._sharding = {}
+        # declared device mesh (tuple of (axis_name, size) pairs), HBM
+        # budget in bytes, and serving KV-pool plan (CacheConfig kwargs)
+        # — inputs to the sharding/memplan lint passes (analysis/passes)
+        self._mesh_axes = None
+        self._device_limit_bytes = None
+        self._kv_plan = None
         # bf16 auto-mixed-precision for MXU ops (set_amp / contrib amp)
         self._amp = False
 
@@ -676,8 +830,54 @@ class Program(object):
 
     def set_sharding(self, name, spec):
         """Attach a PartitionSpec to var `name`; bumps the version so the
-        executor's lowering cache re-jits with the new in_shardings."""
+        executor's lowering cache re-jits with the new in_shardings.
+        When the var exists in the IR the spec also becomes a
+        first-class `Variable.sharding` annotation (canonical tuple
+        form, serialized by io.py); unknown names keep the legacy
+        side-table-only behavior."""
+        for b in self.blocks:
+            v = b.vars.get(name)
+            if v is not None:
+                v.sharding = spec  # setter syncs self._sharding + bumps
+                return
         self._sharding[name] = spec
+        self._bump()
+
+    def set_mesh_axes(self, axes):
+        """Declare the device mesh the sharding specs refer to.  Accepts
+        a name->size dict, a sequence of (name, size) pairs, a jax Mesh
+        (axis_names/shape), or None to clear.  The D019 lint checks spec
+        axes against this declaration."""
+        if axes is None:
+            self._mesh_axes = None
+        elif hasattr(axes, 'axis_names'):  # jax.sharding.Mesh
+            self._mesh_axes = tuple((str(a), int(axes.shape[a]))
+                                    for a in axes.axis_names)
+        elif isinstance(axes, dict):
+            self._mesh_axes = tuple((str(k), int(v))
+                                    for k, v in axes.items())
+        else:
+            self._mesh_axes = tuple((str(k), int(v)) for k, v in axes)
+        self._bump()
+
+    def mesh_axes(self):
+        """Declared mesh as a name->size dict, or None."""
+        return dict(self._mesh_axes) if self._mesh_axes is not None else None
+
+    def set_device_limit(self, limit_bytes):
+        """Declare the per-device HBM budget the memplan lint (D020)
+        checks against; None clears it (the pass then queries the
+        runtime's memory_stats when available)."""
+        self._device_limit_bytes = (int(limit_bytes)
+                                    if limit_bytes is not None else None)
+        self._bump()
+
+    def set_kv_plan(self, **cache_config_kwargs):
+        """Declare the serving KV-cache pool this program runs against
+        (serving.generation.CacheConfig kwargs); the memplan lint folds
+        its pool bytes into the per-device footprint.  No kwargs clears
+        the plan."""
+        self._kv_plan = dict(cache_config_kwargs) or None
         self._bump()
 
     def global_block(self):
@@ -742,6 +942,8 @@ class Program(object):
                     nv.is_tensor_array = True
                 if getattr(v, 'lod_length_name', None):
                     nv.lod_length_name = v.lod_length_name
+                if v._sharding_spec is not None:
+                    nv._sharding_spec = v._sharding_spec
                 nb.vars[name] = nv
             for op in b.ops:
                 role = op.attrs.get('op_role', OpRole.Forward)
@@ -760,6 +962,10 @@ class Program(object):
                 nop.output_is_list = dict(op.output_is_list)
                 nb.ops.append(nop)
             p.blocks.append(nb)
+        p._sharding = dict(self._sharding)
+        p._mesh_axes = self._mesh_axes
+        p._device_limit_bytes = self._device_limit_bytes
+        p._kv_plan = dict(self._kv_plan) if self._kv_plan else None
         if for_test:
             p._is_test = True
         p._bump()

@@ -17,7 +17,7 @@ side-effect op set.  `kill_overwrites` selects the rule.
 """
 
 __all__ = ['SIDE_EFFECT_OPS', 'sub_block_reads', 'persistable_names',
-           'block_live_mask', 'control_flow_pinned']
+           'block_live_mask', 'control_flow_pinned', 'block_last_reads']
 
 # ops that are alive regardless of dataflow (observable effects)
 SIDE_EFFECT_OPS = {'print', 'py_func', '__backward__', 'write_to_array'}
@@ -94,6 +94,25 @@ def persistable_names(program):
         names |= {n for n, v in b.vars.items()
                   if v.persistable or isinstance(v, Parameter)}
     return names
+
+
+def block_last_reads(program, block):
+    """Name -> index of the LAST op in `block` that reads it, with reads
+    inside a sub-block tree attributed to the op that owns the sub_block
+    (the whole body runs while that op runs).  The liveness half of the
+    static memory planner (analysis/passes/memplan.py): an activation's
+    buffer dies after its last read."""
+    last = {}
+    for i, op in enumerate(block.ops):
+        reads = set(op.input_names())
+        if op.type == '__backward__':
+            reads |= set(op.attrs.get('params', ()))
+        sub = op.attrs.get('sub_block')
+        if sub is not None:
+            reads |= sub_block_reads(program, sub)
+        for n in reads:
+            last[n] = i
+    return last
 
 
 def block_live_mask(program, block, root_names, persistable=None,

@@ -1,0 +1,94 @@
+"""First-class sharding annotations for the Program IR.
+
+A *spec* mirrors `jax.sharding.PartitionSpec`: one entry per tensor
+dimension, where each entry is None (replicated), a mesh-axis name, or a
+tuple of mesh-axis names (that dimension is split over the product of
+those axes).  The canonical in-IR form is a plain tuple so specs hash,
+compare, and round-trip through `program_to_desc` byte-stably — the desc
+layer stores the `spec_to_jsonable` form (nested lists), and
+`desc_to_program` restores the tuple form via `spec_from_jsonable`.
+
+`Variable.sharding` (core/framework.py) stores the canonical form and
+syncs `Program._sharding` (the executor's in_shardings source) with the
+PartitionSpec view, so annotating a var once serves both the lint passes
+(analysis/passes/sharding.py) and the lowering path.
+"""
+
+
+def normalize_spec(spec):
+    """Canonicalize any accepted spec spelling to a tuple (or None).
+
+    Accepts None, a PartitionSpec, a single axis-name string, or a
+    sequence whose entries are None / str / sequence-of-str.
+    """
+    if spec is None:
+        return None
+    if isinstance(spec, str):
+        return (spec,)
+    entries = []
+    for e in tuple(spec):
+        if e is None or isinstance(e, str):
+            entries.append(e)
+        else:
+            sub = tuple(e)
+            for a in sub:
+                if not isinstance(a, str):
+                    raise TypeError(
+                        'sharding spec entries must be None, a mesh-axis '
+                        'name, or a tuple of names; got %r' % (e,))
+            entries.append(sub)
+    return tuple(entries)
+
+
+def spec_to_jsonable(spec):
+    """Canonical tuple spec -> JSON-stable form (nested lists)."""
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def spec_from_jsonable(obj):
+    """Inverse of spec_to_jsonable."""
+    if obj is None:
+        return None
+    return tuple(tuple(e) if isinstance(e, list) else e for e in obj)
+
+
+def to_partition_spec(spec):
+    """Canonical spec -> jax.sharding.PartitionSpec (None passes through)."""
+    if spec is None:
+        return None
+    from jax.sharding import PartitionSpec
+    return PartitionSpec(*spec)
+
+
+def spec_axes(spec):
+    """The set of mesh-axis names a spec references."""
+    axes = set()
+    for e in (spec or ()):
+        if e is None:
+            continue
+        if isinstance(e, str):
+            axes.add(e)
+        else:
+            axes.update(e)
+    return axes
+
+
+def spec_divisor(spec, mesh_axes):
+    """How many devices one shard of a spec'd tensor is divided over:
+    the product of the mesh sizes of every referenced axis.  `mesh_axes`
+    is a name->size dict (or None -> divisor 1); axes the mesh does not
+    declare count as 1 (D019 reports them separately)."""
+    if not spec or not mesh_axes:
+        return 1
+    d = 1
+    for a in spec_axes(spec):
+        d *= int(mesh_axes.get(a, 1))
+    return max(1, d)
+
+
+def specs_equal(a, b):
+    """Spec equality on the canonical form (None == all-replicated is
+    NOT assumed: None means 'unannotated', which merges with anything)."""
+    return normalize_spec(a) == normalize_spec(b)

@@ -10,6 +10,7 @@ import numpy as np
 
 from .core.framework import (Program, Variable, Parameter,
                              default_main_program)
+from .core import sharding as _sharding
 from .core.executor import global_scope
 from .core.retry import retry_with_backoff
 from .testing import faults as _faults
@@ -131,6 +132,7 @@ def program_to_desc(program):
                 'is_parameter': isinstance(v, Parameter),
                 'trainable': getattr(v, 'trainable', False),
                 'lod_length_name': getattr(v, 'lod_length_name', None),
+                'sharding': _sharding.spec_to_jsonable(v.sharding),
             })
         ops = []
         for op in b.ops:
@@ -150,7 +152,11 @@ def program_to_desc(program):
         blocks.append({'idx': b.idx, 'parent_idx': b.parent_idx,
                        'vars': vars_, 'ops': ops})
     return {'version': 1, 'random_seed': program.random_seed,
-            'blocks': blocks}
+            'blocks': blocks,
+            'mesh_axes': ([list(p) for p in program._mesh_axes]
+                          if program._mesh_axes is not None else None),
+            'device_limit_bytes': program._device_limit_bytes,
+            'kv_plan': program._kv_plan}
 
 
 def _jsonable_attrs(attrs):
@@ -187,6 +193,9 @@ def desc_to_program(desc):
                              is_data=vd['is_data'])
             if vd.get('lod_length_name'):
                 v.lod_length_name = vd['lod_length_name']
+            if vd.get('sharding') is not None:
+                # sync the legacy side-table + PartitionSpec view too
+                v.sharding = _sharding.spec_from_jsonable(vd['sharding'])
             b.vars[v.name] = v
         for od in bd['ops']:
             op = Operator(b, od['type'])
@@ -206,6 +215,13 @@ def desc_to_program(desc):
                 op.source_loc = tuple(od['source_loc'])
             b.ops.append(op)
         program.blocks.append(b)
+    if desc.get('mesh_axes') is not None:
+        program._mesh_axes = tuple((str(n), int(s))
+                                   for n, s in desc['mesh_axes'])
+    if desc.get('device_limit_bytes') is not None:
+        program._device_limit_bytes = int(desc['device_limit_bytes'])
+    if desc.get('kv_plan') is not None:
+        program._kv_plan = dict(desc['kv_plan'])
     program._bump()
     return program
 

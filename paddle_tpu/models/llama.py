@@ -163,6 +163,54 @@ def make_batch(token_rows, max_len):
     return {'tokens': toks, 'labels': lbls, 'loss_mask': mask}
 
 
+# ----------------------------------------- serving programs (zoo/lint)
+
+def generation_program(config='tiny', mode='decode', temperature=0.0,
+                       top_k=0, kv_slots=4, **overrides):
+    """The serving-side llama paths as declarative Programs, so the
+    static analyzer covers what serving/generation/ actually runs:
+
+      * mode='prefill': full-window forward, fetch [B, T, V] logits —
+        the shape of DecodeRuntime's prompt pass
+      * mode='decode': forward + last-position slice + `sample_tokens`,
+        fetch [B] next token ids — one decode step (the op's
+        `(seed, position)` stream keeps replay deterministic)
+
+    decode mode also declares the slotted KV pool on the program
+    (`set_kv_plan`, CacheConfig arithmetic) so the memplan pass folds
+    the cache bytes a real serving deployment would pin into its
+    per-device footprint.  Weights use the training parameter names —
+    a trained scope serves directly.
+    """
+    cfg = dict(CONFIGS[config] if isinstance(config, str) else config)
+    cfg.update(overrides)
+    T, V, D = cfg['max_len'], cfg['vocab'], cfg['d_model']
+
+    tokens = layers.data('tokens', shape=[T, 1], dtype='int64')
+    x = layers.embedding(
+        tokens, size=[V, D],
+        param_attr=ParamAttr(name='tok_emb',
+                             initializer=Normal(0., 0.02)))
+    for i in range(cfg['n_layer']):
+        x = decoder_layer(x, cfg, 'layer_%d' % i)
+    x = layers.rms_norm(x, param_attr=ParamAttr(name='final_norm'))
+    logits = _linear(x, V, 'lm_proj')                    # [B, T, V]
+    out = {'logits': logits, 'feeds': [tokens], 'config': cfg,
+           'fetches': [logits]}
+    if mode == 'decode':
+        last = layers.slice(logits, axes=[1], starts=[T - 1], ends=[T])
+        last = layers.squeeze(last, axes=[1])            # [B, V]
+        nxt = layers.sample_tokens(last, temperature=temperature,
+                                   top_k=top_k)
+        out['next_token'] = nxt
+        out['fetches'] = [nxt]
+        tokens.block.program.set_kv_plan(
+            slots=kv_slots, layers=cfg['n_layer'],
+            kv_heads=cfg['n_kv_head'], max_len=T,
+            head_dim=D // cfg['n_head'])
+    return out
+
+
 # ----------------------------------------------------------- decoding
 
 def make_decoder(scope, config='tiny', temperature=0.0, **overrides):

@@ -4,7 +4,6 @@ Parity: reference accuracy_op, auc_op, mean_iou_op, precision_recall.
 Streaming state (AUC stat buckets etc.) lives in persistable vars updated by
 the op, same pattern as the reference.
 """
-import jax
 import jax.numpy as jnp
 
 from ..core.registry import register

@@ -8,9 +8,8 @@ buffers — no per-parameter kernel launches like the reference's GPU path.
 """
 import numpy as np
 
-from .core import framework
 from .core.framework import (Variable, default_main_program,
-                             default_startup_program, op_role_guard, OpRole)
+                             op_role_guard, OpRole)
 from .core import unique_name
 from .core.backward import append_backward
 from .initializer import Constant

@@ -8,8 +8,7 @@ import pytest
 from paddle_tpu import native
 from paddle_tpu.native import fallback
 from paddle_tpu.native.datafeed import (BatchReader, RecordReader,
-                                        RecordWriter, DataFeedDesc,
-                                        write_records)
+                                        DataFeedDesc, write_records)
 
 
 def _make_samples(n):

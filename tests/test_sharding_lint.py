@@ -1,0 +1,431 @@
+"""PR-19 sharding-aware analyzer: first-class sharding attrs (IR +
+desc round-trips + version bumps), the sharding/memplan/donation lint
+passes (D017..D021), the `pt_lint --memplan` surface, and the serving
+generation zoo entries (docs/analysis.md)."""
+import json
+import os
+import sys
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.sharding import (normalize_spec, spec_axes,
+                                      spec_divisor, spec_from_jsonable,
+                                      spec_to_jsonable)
+from paddle_tpu.io import desc_to_program, program_to_desc
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', 'tools'))
+import pt_lint  # noqa: E402
+
+
+def _codes(result):
+    return set(result.codes())
+
+
+def _by_code(result, code):
+    return [d for d in result if d.code == code]
+
+
+# ------------------------------------------------ core/sharding helpers
+
+def test_spec_helpers():
+    assert normalize_spec(None) is None
+    assert normalize_spec('data') == ('data',)
+    assert normalize_spec(['data', None]) == ('data', None)
+    assert normalize_spec((('data', 'model'), None)) == \
+        (('data', 'model'), None)
+    from jax.sharding import PartitionSpec as P
+    assert normalize_spec(P('model', None)) == ('model', None)
+    with pytest.raises(TypeError):
+        normalize_spec([3])
+    spec = (('data', 'model'), None, 'seq')
+    assert spec_from_jsonable(spec_to_jsonable(spec)) == spec
+    assert spec_to_jsonable(None) is None
+    assert spec_axes(spec) == {'data', 'model', 'seq'}
+    assert spec_divisor(spec, {'data': 4, 'model': 2, 'seq': 2}) == 16
+    assert spec_divisor(spec, None) == 1
+    assert spec_divisor((None,), {'data': 4}) == 1
+
+
+# ------------------------------------- first-class attrs + version bumps
+
+def test_variable_sharding_syncs_program_table():
+    from jax.sharding import PartitionSpec as P
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[8], dtype='float32')
+    v0 = prog._version
+    x.sharding = ('data', None)
+    assert prog._version > v0
+    assert x.sharding == ('data', None)
+    assert prog._sharding['x'] == P('data', None)
+    # set_sharding delegates to the var when it exists
+    prog.set_sharding('x', P(None, 'model'))
+    assert x.sharding == (None, 'model')
+    # clearing pops the legacy table too
+    x.sharding = None
+    assert 'x' not in prog._sharding
+
+
+def test_attr_mutation_bumps_version():
+    """Satellite: in-place Operator/Variable attr mutation must bump the
+    program version so lint memoization stays sound."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.relu(x)
+    op = y.op
+    v = prog._version
+    op.attrs['alpha'] = 1.0            # raw in-place set, not _set_attr
+    assert prog._version > v
+    v = prog._version
+    op.attrs['alpha'] = 1.0            # identical value: no bump
+    assert prog._version == v
+    op.attrs.setdefault('alpha', 2.0)  # present key: no bump
+    assert prog._version == v
+    op.attrs.pop('alpha')
+    assert prog._version > v
+    v = prog._version
+    op.attrs.pop('alpha', None)        # absent key: no bump
+    assert prog._version == v
+    for mutate in (lambda: setattr(x, 'shape', (-1, 9)),
+                   lambda: setattr(x, 'persistable', True),
+                   lambda: setattr(x, 'stop_gradient', True),
+                   lambda: setattr(x, 'dtype', 'float32')):
+        v = prog._version
+        mutate()
+        assert prog._version > v
+
+
+def test_lint_memo_invalidated_by_inplace_attr_mutation():
+    """Regression: Program.lint via apply_lint_policy memoizes on
+    _version — an in-place attr edit must invalidate it."""
+    from paddle_tpu.analysis import apply_lint_policy
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.relu(x)
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        r1 = apply_lint_policy(prog, feed_names=('x',),
+                               fetch_names=(y.name,), mode='warn')
+        assert 'D002' not in _codes(r1)
+        # break the op in place: unknown type would previously serve
+        # the stale memoized clean result
+        y.op.type = 'not_a_real_op'
+        y.op.attrs['broken'] = 1  # in-place attr bump
+        r2 = apply_lint_policy(prog, feed_names=('x',),
+                               fetch_names=(y.name,), mode='warn')
+    assert r2 is not r1
+    assert 'D002' in _codes(r2)
+
+
+# ------------------------------------------------------ desc round-trip
+
+def _annotated_program():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[4, 8], dtype='float32')
+        w = layers.create_parameter([8, 8], 'float32', name='w_rt')
+        y = layers.fc(x, size=8, param_attr=fluid.ParamAttr(name='fc_rt'),
+                      bias_attr=False)
+    x.sharding = (None, 'data', None)
+    w.sharding = (None, ('model', 'data'))
+    prog.set_mesh_axes({'data': 2, 'model': 4})
+    prog.set_device_limit(1 << 30)
+    prog.set_kv_plan(slots=2, layers=1, kv_heads=2, max_len=8,
+                     head_dim=4)
+    return prog, y
+
+
+def test_desc_roundtrip_sharding_byte_identical():
+    prog, _ = _annotated_program()
+    d1 = program_to_desc(prog)
+    prog2 = desc_to_program(json.loads(json.dumps(d1)))
+    d2 = program_to_desc(prog2)
+    assert json.dumps(d1, sort_keys=True) == json.dumps(d2,
+                                                        sort_keys=True)
+    b2 = prog2.global_block()
+    assert b2.var('x').sharding == (None, 'data', None)
+    assert b2.var('w_rt').sharding == (None, ('model', 'data'))
+    from jax.sharding import PartitionSpec as P
+    assert prog2._sharding['w_rt'] == P(None, ('model', 'data'))
+    assert prog2.mesh_axes() == {'data': 2, 'model': 4}
+    assert prog2._device_limit_bytes == 1 << 30
+    assert prog2._kv_plan['slots'] == 2
+
+
+def test_old_desc_without_sharding_loads_clean():
+    """A desc written before sharding attrs existed loads with empty
+    specs and introduces zero new diagnostics."""
+    base = fluid.Program()
+    with fluid.program_guard(base, fluid.Program()):
+        bx = layers.data('x', shape=[4, 8], dtype='float32')
+        layers.create_parameter([8, 8], 'float32', name='w_rt')
+        by = layers.fc(bx, size=8,
+                       param_attr=fluid.ParamAttr(name='fc_rt'),
+                       bias_attr=False)
+    desc = program_to_desc(base)
+    # simulate the pre-PR-19 on-disk shape: strip the new keys entirely
+    for key in ('mesh_axes', 'device_limit_bytes', 'kv_plan'):
+        desc.pop(key)
+    for bd in desc['blocks']:
+        for vd in bd['vars']:
+            vd.pop('sharding')
+    old = desc_to_program(desc)
+    assert all(v.sharding is None for v in old.list_vars())
+    assert old._sharding == {}
+    assert old.mesh_axes() is None
+    ref = base.lint(feed_names=('x',), fetch_list=[by.name])
+    got = old.lint(feed_names=('x',), fetch_list=[by.name])
+    assert _codes(got) <= _codes(ref)
+    assert not _by_code(got, 'D017') and not _by_code(got, 'D018') \
+        and not _by_code(got, 'D019') and not _by_code(got, 'D020') \
+        and not _by_code(got, 'D021')
+
+
+def test_clone_carries_sharding_state():
+    prog, _ = _annotated_program()
+    c = prog.clone()
+    assert c.global_block().var('x').sharding == (None, 'data', None)
+    assert c.mesh_axes() == {'data': 2, 'model': 4}
+    assert c._device_limit_bytes == 1 << 30
+    assert c._kv_plan == prog._kv_plan and c._kv_plan is not prog._kv_plan
+
+
+# ------------------------------------------------- the sharding pass
+
+def _mesh_prog():
+    prog = fluid.Program()
+    guard = fluid.program_guard(prog, fluid.Program())
+    prog.set_mesh_axes({'data': 2, 'model': 2})
+    return prog, guard
+
+
+def test_d019_mesh_axis_typo_and_quiet_without_mesh():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[8], dtype='float32')
+        y = layers.relu(x)
+    x.sharding = (None, 'modle')
+    res = prog.lint(feed_names=('x',), fetch_list=[y])
+    assert not _by_code(res, 'D019')       # no mesh declared: quiet
+    prog.set_mesh_axes({'data': 2, 'model': 2})
+    res = prog.lint(feed_names=('x',), fetch_list=[y])
+    d = _by_code(res, 'D019')
+    assert len(d) == 1 and d[0].severity == 'error'
+    assert 'modle' in d[0].message
+    assert 'model' in (d[0].fixit or '')   # did-you-mean
+
+
+def test_d018_reshard_between_inputs_and_declared():
+    prog, guard = _mesh_prog()
+    with guard:
+        a = layers.data('a', shape=[16], dtype='float32')
+        b = layers.data('b', shape=[16], dtype='float32')
+        s = a + b
+        out = layers.reduce_sum(s)
+    a.sharding = (None, 'data')
+    b.sharding = (None, 'model')
+    res = prog.lint(feed_names=('a', 'b'), fetch_list=[out])
+    d = _by_code(res, 'D018')
+    assert d and d[0].op_type == 'elementwise_add'
+    assert 'bytes' in d[0].message and d[0].source_loc
+    # declared-vs-delivered: annotate the sum's output differently
+    s.sharding = ('data', None)
+    res = prog.lint(feed_names=('a', 'b'), fetch_list=[out])
+    assert any(s.name == x.var for x in _by_code(res, 'D018'))
+
+
+def test_d017_conflicting_producers_and_rank_overflow():
+    prog, guard = _mesh_prog()
+    with guard:
+        a = layers.data('a', shape=[16], dtype='float32')
+        b = layers.data('b', shape=[16], dtype='float32')
+        blk = prog.global_block()
+        c = blk.create_var(name='c', dtype='float32')
+        c.shape = (-1, 16)
+        blk.append_op(type='assign', inputs={'X': a}, outputs={'Out': c})
+        blk.append_op(type='assign', inputs={'X': b}, outputs={'Out': c})
+        out = layers.reduce_sum(a + b)
+    a.sharding = (None, 'data')
+    b.sharding = (None, 'model')
+    res = prog.lint(feed_names=('a', 'b'), fetch_list=[out, 'c'])
+    d = _by_code(res, 'D017')
+    assert d and d[0].severity == 'error' and d[0].var == 'c'
+    assert d[0].op_index is not None and d[0].source_loc
+    # rank overflow form
+    a.sharding = ('data', None, 'model')   # rank-2 var, 3 entries
+    res = prog.lint(feed_names=('a', 'b'), fetch_list=[out])
+    assert any('rank' in x.message for x in _by_code(res, 'D017'))
+
+
+def test_sharding_propagates_through_backward():
+    """Grads inherit their parameter's spec through __backward__, so an
+    annotated training program lints without false conflicts."""
+    import paddle_tpu.models.simple as simple
+    prog, start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, start):
+        m = simple.fit_a_line()
+        opt = fluid.optimizer.SGD(learning_rate=0.01)
+        opt.minimize(m['loss'])
+    prog.set_mesh_axes({'data': 2, 'model': 2})
+    for p in prog.all_parameters():
+        if len(p.shape or ()) == 2:
+            prog.set_sharding(p.name, (None, 'model'))
+    res = prog.lint(feed_names=('x', 'y'), fetch_list=[m['loss']])
+    assert not _by_code(res, 'D017') and not _by_code(res, 'D019')
+
+
+# ---------------------------------------------------- the memplan pass
+
+def test_memplan_accounting_and_d020():
+    from paddle_tpu.analysis.passes.memplan import plan_memory
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data('x', shape=[16], dtype='float32')
+        layers.create_parameter([256, 256], 'float32', name='big_w')
+        y = layers.relu(x)
+    plan = plan_memory(prog, feed_names=('x',), fetch_names=(y.name,))
+    assert plan.params_bytes == 256 * 256 * 4
+    assert plan.activation_peak_bytes > 0
+    assert plan.kv_pool_bytes == 0
+    assert plan.to_dict()['total_bytes'] == plan.total_bytes
+    # kv plan folds CacheConfig bytes in
+    prog.set_kv_plan(slots=2, layers=2, kv_heads=2, max_len=8,
+                     head_dim=4)
+    from paddle_tpu.serving.generation.kv_cache import CacheConfig
+    plan = plan_memory(prog, feed_names=('x',), fetch_names=(y.name,))
+    assert plan.kv_pool_bytes == CacheConfig(
+        slots=2, layers=2, kv_heads=2, max_len=8, head_dim=4).bytes()
+    # sharding divides the parameter contribution
+    prog.set_mesh_axes({'model': 4})
+    prog.set_sharding('big_w', (None, 'model'))
+    sharded = plan_memory(prog, feed_names=('x',),
+                          fetch_names=(y.name,))
+    assert sharded.params_bytes == plan.params_bytes // 4
+    # D020 fires only over the declared limit
+    res = prog.lint(feed_names=('x',), fetch_list=[y])
+    assert not _by_code(res, 'D020')
+    prog.set_device_limit(1024)
+    res = prog.lint(feed_names=('x',), fetch_list=[y])
+    d = _by_code(res, 'D020')
+    assert len(d) == 1 and d[0].severity == 'error'
+    assert 'big_w' in d[0].message
+    prog.set_device_limit(1 << 40)
+    res = prog.lint(feed_names=('x',), fetch_list=[y])
+    assert not _by_code(res, 'D020')
+
+
+# --------------------------------------------------- the donation pass
+
+def test_d021_host_feed_and_fetched_param():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        w = layers.create_parameter([8], 'float32', name='w_d21')
+        blk = prog.global_block()
+        blk.append_op(type='assign', inputs={'X': w},
+                      outputs={'Out': w})
+        x = layers.data('x', shape=[8], dtype='float32')
+        out = layers.reduce_sum(x + w)
+    res = prog.lint(feed_names=('x', 'w_d21'),
+                    fetch_list=[out, 'w_d21'])
+    d = _by_code(res, 'D021')
+    assert len(d) == 2 and all(x.severity == 'warning' for x in d)
+    msgs = ' '.join(x.message for x in d)
+    assert 'host-owned feed' in msgs and 'fetched' in msgs
+    assert all(x.op_index is not None for x in d)
+    # neither form present -> quiet
+    res = prog.lint(feed_names=('x',), fetch_list=[out])
+    assert not _by_code(res, 'D021')
+
+
+def test_d021_quiet_without_writeback():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        w = layers.create_parameter([8], 'float32', name='w_nd')
+        x = layers.data('x', shape=[8], dtype='float32')
+        out = layers.reduce_sum(x + w)
+    # no writeback -> no donation -> feeding/fetching the param is safe
+    res = prog.lint(feed_names=('x', 'w_nd'), fetch_list=[out, 'w_nd'])
+    assert not _by_code(res, 'D021')
+
+
+# ------------------------------------------------- the acceptance program
+
+def test_acceptance_program_reports_all_five_codes():
+    """One program with a deliberate sharding conflict, implicit
+    reshard, mesh-axis typo, over-budget KV+param footprint, and a
+    host-array-into-donating-executable path: exactly D017..D021 fire
+    (plus pre-existing codes), each with an op anchor + source_loc."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        a = layers.data('a', shape=[16], dtype='float32')
+        b = layers.data('b', shape=[16], dtype='float32')
+        s = a + b                                     # D018
+        blk = prog.global_block()
+        c = blk.create_var(name='c', dtype='float32')
+        c.shape = (-1, 16)
+        blk.append_op(type='assign', inputs={'X': a}, outputs={'Out': c})
+        blk.append_op(type='assign', inputs={'X': b}, outputs={'Out': c})
+        w = layers.create_parameter([64, 64], 'float32', name='w_acc')
+        blk.append_op(type='assign', inputs={'X': w}, outputs={'Out': w})
+        t = blk.create_var(name='t', dtype='float32')
+        t.shape = (-1, 16)
+        blk.append_op(type='assign', inputs={'X': s}, outputs={'Out': t})
+        out = layers.reduce_sum(t)
+    prog.set_mesh_axes({'data': 2, 'model': 2})
+    blk = prog.global_block()
+    blk.var('a').sharding = (None, 'data')
+    blk.var('b').sharding = (None, 'model')
+    blk.var('w_acc').sharding = (None, 'modle')       # D019 typo
+    prog.set_kv_plan(slots=8, layers=4, kv_heads=4, max_len=128,
+                     head_dim=32)
+    prog.set_device_limit(4096)                        # D020
+    res = prog.lint(feed_names=('a', 'b', 'w_acc'),
+                    fetch_list=[out, 'c'])
+    codes = _codes(res)
+    assert {'D017', 'D018', 'D019', 'D020', 'D021'} <= codes
+    for code in ('D017', 'D018', 'D020', 'D021'):
+        d = _by_code(res, code)[0]
+        assert d.op_type is not None and d.op_index is not None
+        assert d.source_loc, code
+    assert _by_code(res, 'D019')[0].var == 'w_acc'
+    assert _by_code(res, 'D020')[0].message.count('kv pool')
+
+
+# ------------------------------------------- zoo + CLI memplan surface
+
+@pytest.mark.parametrize('name', ['llama_prefill', 'llama_decode'])
+def test_generation_zoo_entries_lint_clean(name):
+    prog, feeds, fetches = pt_lint._zoo_entry(name)()
+    assert feeds == ['tokens'] and fetches
+    res = prog.lint(feed_names=feeds, fetch_list=fetches)
+    assert not res.errors, res.render('error')
+    if name == 'llama_decode':
+        assert prog._kv_plan is not None
+        plan = prog._last_memplan
+        assert plan.kv_pool_bytes > 0
+    assert name in pt_lint.builtin_names()
+
+
+def test_pt_lint_memplan_json_shape():
+    from paddle_tpu.analysis.diagnostics import (DIAG_JSON_KEYS,
+                                                 RESULT_JSON_KEYS)
+    from paddle_tpu.analysis.passes.memplan import MEMPLAN_JSON_KEYS
+    import contextlib
+    import io as _io
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = pt_lint.main(['--builtin', 'llama_decode', '--json',
+                           '--memplan'])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    res = out['results']['builtin:llama_decode']
+    assert set(res) - {'memplan'} == set(RESULT_JSON_KEYS)
+    assert set(res['memplan']) == set(MEMPLAN_JSON_KEYS)
+    assert res['memplan']['kv_pool_bytes'] > 0
+    for d in res['diagnostics']:
+        assert set(d) == set(DIAG_JSON_KEYS)

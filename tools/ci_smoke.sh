@@ -348,13 +348,80 @@ rm -rf "$autotune_cache"
 
 echo "== ci_smoke: ruff =="
 # style/bug gate with the committed ruff.toml; the container image may
-# not ship ruff — skip with a notice rather than fail the smoke
+# not ship ruff (and pip installs are off-limits in CI images) — fall
+# back through `python -m ruff` to the stdlib-AST checker
+# tools/lint_lite.py so SOME source lint always gates the smoke
 if command -v ruff >/dev/null 2>&1; then
     ruff check paddle_tpu/ tests/ tools/
     ruff_rc=$?
+elif python -m ruff --version >/dev/null 2>&1; then
+    python -m ruff check paddle_tpu/ tests/ tools/
+    ruff_rc=$?
 else
-    echo "ci_smoke: ruff not installed; skipping lint step"
-    ruff_rc=0
+    echo "ci_smoke: ruff not installed; running tools/lint_lite.py"
+    python tools/lint_lite.py paddle_tpu/ tests/ tools/
+    ruff_rc=$?
+fi
+
+echo "== ci_smoke: pt-lint --json schema =="
+# the machine-readable lint surface is a contract like the bench
+# telemetry schema: validate every --all-builtin --json --memplan
+# result against the key tuples diagnostics.py pins, and require the
+# serving-side generation entries to be present and error-free
+timeout -k 10 600 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import subprocess
+import sys
+
+from paddle_tpu.analysis.diagnostics import (CODES, SEVERITIES,
+                                             DIAG_JSON_KEYS,
+                                             RESULT_JSON_KEYS)
+from paddle_tpu.analysis.passes.memplan import MEMPLAN_JSON_KEYS
+
+proc = subprocess.run(
+    [sys.executable, 'tools/pt_lint.py', '--all-builtin', '--json',
+     '--memplan', '--fail-on', 'error'],
+    capture_output=True, text=True)
+if proc.returncode not in (0, 2):
+    sys.exit('ci_smoke: pt_lint --json crashed (rc=%d):\n%s'
+             % (proc.returncode, proc.stderr[-2000:]))
+out = json.loads(proc.stdout)
+if set(out) != {'fail_on', 'results'}:
+    sys.exit('ci_smoke: unexpected top-level keys %s' % sorted(out))
+results = out['results']
+for label in ('builtin:llama_prefill', 'builtin:llama_decode'):
+    if label not in results:
+        sys.exit('ci_smoke: generation program %s missing from '
+                 '--all-builtin' % label)
+checked = 0
+for label, res in results.items():
+    if 'error' in res:
+        sys.exit('ci_smoke: %s failed to build: %s'
+                 % (label, res['error']))
+    if set(res) - {'memplan'} != set(RESULT_JSON_KEYS):
+        sys.exit('ci_smoke: %s result keys %s != %s'
+                 % (label, sorted(res), sorted(RESULT_JSON_KEYS)))
+    if set(res['memplan']) != set(MEMPLAN_JSON_KEYS):
+        sys.exit('ci_smoke: %s memplan keys %s != %s'
+                 % (label, sorted(res['memplan']),
+                    sorted(MEMPLAN_JSON_KEYS)))
+    if res['errors']:
+        sys.exit('ci_smoke: %s has %d lint error(s)'
+                 % (label, res['errors']))
+    for d in res['diagnostics']:
+        if set(d) != set(DIAG_JSON_KEYS):
+            sys.exit('ci_smoke: %s diagnostic keys %s != %s'
+                     % (label, sorted(d), sorted(DIAG_JSON_KEYS)))
+        if d['code'] not in CODES or d['severity'] not in SEVERITIES:
+            sys.exit('ci_smoke: %s bad code/severity %s/%s'
+                     % (label, d['code'], d['severity']))
+        checked += 1
+print('ci_smoke: pt_lint --json schema OK (%d programs, %d diagnostics, '
+      'all memplans shaped)' % (len(results), checked))
+EOF
+lint_schema_rc=$?
+if [ "$lint_schema_rc" -ne 0 ]; then
+    echo "ci_smoke: pt-lint json schema gate FAILED (rc=$lint_schema_rc)"
 fi
 
 echo "== ci_smoke: fault-injection soak =="
@@ -770,6 +837,7 @@ if [ "$t1_rc" -ne 0 ]; then
     echo "ci_smoke: tier-1 tests FAILED (rc=$t1_rc)"
 fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
+    [ "$lint_schema_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
     [ "$opt_gate_rc" -eq 0 ] && [ "$emit_zoo_rc" -eq 0 ] && \
     [ "$kg_zoo_rc" -eq 0 ] && [ "$autotune_rc" -eq 0 ] && \

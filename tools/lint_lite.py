@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""lint_lite — stdlib-only fallback for the ci_smoke ruff gate.
+
+The CI container cannot pip-install ruff, so this covers the highest-
+signal, zero-false-positive slice of `ruff check` with nothing but ast:
+
+  * E999  syntax error (the file does not parse)
+  * F401  imported name never used anywhere in the module
+
+Deliberately conservative — an import is only reported when its bound
+name appears in NO identifier and NO string literal of the module (string
+scanning keeps __all__ re-exports, doctest snippets, and lazy
+`globals()[name]` idioms quiet), the line carries no `# noqa`, and the
+file is not an `__init__.py` (re-export surface by design).
+
+    python tools/lint_lite.py paddle_tpu/ tests/ tools/
+
+Exit 1 when findings exist, 0 otherwise.
+"""
+import ast
+import os
+import re
+import sys
+
+__all__ = ['check_file', 'main']
+
+_WORD = re.compile(r'[A-Za-z_][A-Za-z0-9_]*')
+
+
+def _collect_imports(tree):
+    """[(bound_name, lineno)] for plain imports; star imports skipped."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split('.')[0]
+                out.append((name, node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == '__future__':
+                continue
+            for a in node.names:
+                if a.name == '*':
+                    continue
+                out.append((a.asname or a.name, node.lineno))
+    return out
+
+
+def _used_words(tree):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            used.add(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used.update(_WORD.findall(node.value))
+    return used
+
+
+def check_file(path):
+    with open(path, 'rb') as f:
+        src = f.read()
+    try:
+        text = src.decode('utf-8')
+        tree = ast.parse(text, filename=path)
+    except SyntaxError as e:
+        return ['%s:%s: E999 syntax error: %s' % (path, e.lineno, e.msg)]
+    except UnicodeDecodeError as e:
+        return ['%s:1: E999 not utf-8: %s' % (path, e)]
+    if os.path.basename(path) == '__init__.py':
+        return []
+    lines = text.split('\n')
+    findings = []
+    imports = _collect_imports(tree)
+    if not imports:
+        return findings
+    used = _used_words(tree)
+    counts = {}
+    for name, _ in imports:
+        counts[name] = counts.get(name, 0) + 1
+    for name, lineno in imports:
+        if name in used or name.startswith('_'):
+            continue
+        if counts[name] > 1:
+            # re-imported under a guard (try/except fallbacks): the ast
+            # walk cannot tell which binding wins — stay quiet
+            continue
+        line = lines[lineno - 1] if lineno - 1 < len(lines) else ''
+        if 'noqa' in line:
+            continue
+        findings.append("%s:%d: F401 '%s' imported but unused"
+                        % (path, lineno, name))
+    return findings
+
+
+def _iter_py(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ('__pycache__', '.git')]
+            for f in sorted(files):
+                if f.endswith('.py'):
+                    yield os.path.join(root, f)
+
+
+def main(argv=None):
+    paths = (argv if argv is not None else sys.argv[1:]) or ['.']
+    findings = []
+    n = 0
+    for path in _iter_py(paths):
+        n += 1
+        findings.extend(check_file(path))
+    for f in findings:
+        print(f)
+    print('lint_lite: %d file(s), %d finding(s)' % (n, len(findings)))
+    return 1 if findings else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

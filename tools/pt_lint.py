@@ -53,6 +53,10 @@ def _zoo_entry(name):
         'fit_a_line': lambda: M.simple.fit_a_line(),
         'recommender': lambda: M.simple.recommender(),
         'llama': lambda: M.llama.build(),
+        'llama_prefill': lambda: M.llama.generation_program(
+            mode='prefill'),
+        'llama_decode': lambda: M.llama.generation_program(
+            mode='decode'),
     }
     if name not in builders:
         raise KeyError('unknown builtin %r (have: %s)'
@@ -78,7 +82,8 @@ def _zoo_entry(name):
 def builtin_names():
     return ['mnist', 'resnet', 'vgg', 'se_resnext', 'stacked_lstm',
             'transformer', 'ctr_deepfm', 'ctr_wide_deep', 'word2vec',
-            'fit_a_line', 'recommender', 'llama']
+            'fit_a_line', 'recommender', 'llama', 'llama_prefill',
+            'llama_decode']
 
 
 # --------------------------------------------------- saved-model loading
@@ -100,14 +105,21 @@ def _lint_one(label, build_fn, args):
     try:
         program, feeds, fetches = build_fn()
     except Exception as e:  # noqa: BLE001 - reported, exit 1
-        return label, None, 'load/build failed: %s' % e
+        return label, None, None, 'load/build failed: %s' % e
     bucketer = None
     if args.seq_names or args.bucketed:
         bucketer = fluid.FeedBucketer(mask_name='__mask__',
                                       seq_names=args.seq_names or ())
     result = program.lint(feed_names=feeds, fetch_list=fetches,
                           bucketer=bucketer, optimize=args.optimize)
-    return label, result, None
+    plan = None
+    if args.memplan:
+        plan = getattr(program, '_last_memplan', None)
+        if plan is None:  # memplan pass filtered out via passes=
+            from paddle_tpu.analysis.passes.memplan import plan_memory
+            plan = plan_memory(program, feed_names=feeds,
+                               fetch_names=fetches)
+    return label, result, plan, None
 
 
 def main(argv=None):
@@ -136,6 +148,10 @@ def main(argv=None):
                          'exist (default error)')
     ap.add_argument('--json', action='store_true',
                     help='emit one JSON object instead of text')
+    ap.add_argument('--memplan', action='store_true',
+                    help='also report the static per-device memory plan '
+                         '(params + optimizer state + activation peak + '
+                         'kv pool; docs/analysis.md) per target')
     ap.add_argument('--seq-names', action='append', default=[],
                     metavar='FEED',
                     help='assume a FeedBucketer covering this sequence '
@@ -168,7 +184,7 @@ def main(argv=None):
     load_failed = 0
     out = {}
     for label, build_fn, in targets:
-        label, result, err = _lint_one(label, build_fn, args)
+        label, result, plan, err = _lint_one(label, build_fn, args)
         if err is not None:
             load_failed += 1
             if args.json:
@@ -179,9 +195,13 @@ def main(argv=None):
         gated += len(result.at_least(args.fail_on))
         if args.json:
             out[label] = result.to_dict()
+            if plan is not None:
+                out[label]['memplan'] = plan.to_dict()
         else:
             print('== %s' % label)
             text = result.render(args.min_severity)
+            if plan is not None:
+                text += '\n' + plan.render_table()
             print('\n'.join('  ' + line for line in text.split('\n')))
     if args.json:
         print(json.dumps({'fail_on': args.fail_on, 'results': out},
