@@ -35,8 +35,26 @@ MEMPLAN_JSON_KEYS = ('params_bytes', 'opt_state_bytes',
                      'total_bytes', 'limit_bytes', 'limit_source',
                      'peak_op', 'top', 'mesh_axes', 'batch')
 
+_UNSET = object()
 
-def _var_bytes(v, mesh, batch):
+
+def _zero_specs(program):
+    """{name: spec} the shard pass's ZeRO tier WILL apply when it runs
+    on this program — so the plan divides persistable bytes by the same
+    divisor the executed partitioning does.  Empty when the pass is off
+    (PT_SHARD/PT_OPT/skip), no mesh is declared, or the specs are
+    already applied (optimized programs: plan_zero_specs skips vars
+    already split over the data axis, so no double division)."""
+    try:
+        from ...core.passes import shard
+        if not shard.active_for(program):
+            return {}
+        return shard.plan_zero_specs(program)[0]
+    except Exception:
+        return {}
+
+
+def _var_bytes(v, mesh, batch, spec=_UNSET):
     if v is None or v.shape is None:
         return 0
     n = 1
@@ -46,7 +64,9 @@ def _var_bytes(v, mesh, batch):
         itemsize = v.np_dtype.itemsize
     except Exception:
         itemsize = 4
-    return (n * itemsize) // spec_divisor(v._sharding_spec, mesh)
+    if spec is _UNSET:
+        spec = v._sharding_spec
+    return (n * itemsize) // spec_divisor(spec, mesh)
 
 
 def _fmt_bytes(b):
@@ -147,14 +167,16 @@ def plan_memory(program, feed_names=(), fetch_names=(), batch=1):
 
     params_bytes = 0
     opt_bytes = 0
+    zspecs = _zero_specs(program)
     for b in program.blocks:
         for name, v in b.vars.items():
+            spec = zspecs[name] if name in zspecs else _UNSET
             if isinstance(v, Parameter):
-                by = _var_bytes(v, mesh, batch)
+                by = _var_bytes(v, mesh, batch, spec)
                 params_bytes += by
                 contrib.append((name, 'param', by))
             elif v.persistable and not getattr(v, 'is_data', False):
-                by = _var_bytes(v, mesh, batch)
+                by = _var_bytes(v, mesh, batch, spec)
                 opt_bytes += by
                 contrib.append((name, 'opt_state', by))
 

@@ -23,10 +23,16 @@ var name, seeded from the first-class `Variable.sharding` annotations:
 D019 stays quiet when no mesh is declared — annotating specs without
 declaring a mesh is the common single-host authoring state.
 """
-from ...core.sharding import normalize_spec, spec_axes, spec_divisor
+from ...core.sharding import (normalize_spec, spec_axes, spec_divisor,
+                              spec_from_jsonable)
 from ..engine import register_pass
 
 __all__ = ['run']
+
+# explicit collectives inserted by core/passes/shard.py — their dst_spec
+# attr IS the output layout, and they never trip D018 themselves: they
+# are what a materialized D018 looks like
+_COLLECTIVE = {'reshard', 'all_gather', 'grad_allreduce'}
 
 # ops whose (first) output keeps the layout of their X/Y inputs
 _SAME_LAYOUT = {
@@ -44,6 +50,21 @@ _MATMUL = {'mul', 'matmul', 'fc'}
 _AXIS_NAME_ATTRS = ('axis_name', 'mesh_axis')
 
 _BACKWARD_OP = '__backward__'
+
+
+def _trim(spec):
+    """Strip redundant trailing None entries (PartitionSpec semantics:
+    unmentioned trailing dims are replicated)."""
+    spec = tuple(spec or ())
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    return spec
+
+
+def _eqspec(a, b):
+    """Layout equality up to trailing replication — (None,) and
+    (None, None) describe the same placement."""
+    return _trim(a) == _trim(b)
 
 
 def _declared_spec(block, name):
@@ -125,7 +146,7 @@ class _ShardingInterp(object):
         conflicting non-None forcings from two producers are D017."""
         prev = self.forced.get(name)
         if spec is not None and prev is not None and \
-                prev[0] is not None and prev[0] != spec:
+                prev[0] is not None and not _eqspec(prev[0], spec):
             p_spec, p_block, p_i, p_op = prev
             self.diags.append(self.ctx.diag(
                 'D017', 'error',
@@ -157,7 +178,7 @@ class _ShardingInterp(object):
                     fixit='shorten the spec to one entry per dimension',
                     pass_name='sharding'))
             if declared is not None:
-                if spec is not None and spec != declared:
+                if spec is not None and not _eqspec(spec, declared):
                     # dataflow delivers one layout, the annotation
                     # demands another: XLA reshards at the producer
                     self._reshard(op, i, block, name, spec, declared,
@@ -198,6 +219,23 @@ class _ShardingInterp(object):
         """Op-type transfer function: input specs -> {out name: spec}."""
         outs = {n: None for n in op.output_names()}
         first_out = (op.outputs.get('Out') or [None])[0]
+        if op.type in _COLLECTIVE:
+            for a in ('src_spec', 'dst_spec'):
+                raw = op.attrs.get(a)
+                if raw is not None:
+                    try:
+                        self.check_axes(normalize_spec(
+                            spec_from_jsonable(raw)), block, op=op,
+                            op_index=i, what='attr %s' % a)
+                    except Exception:
+                        pass
+            if first_out is not None:
+                try:
+                    outs[first_out] = normalize_spec(
+                        spec_from_jsonable(op.attrs.get('dst_spec')))
+                except Exception:
+                    outs[first_out] = None
+            return outs
         if op.type in _SAME_LAYOUT:
             merged = None
             merged_from = None
@@ -208,7 +246,7 @@ class _ShardingInterp(object):
                         continue
                     if merged is None:
                         merged, merged_from = s, n
-                    elif s != merged:
+                    elif not _eqspec(s, merged):
                         # two inputs arrive in different layouts: the
                         # later (usually smaller) one gets resharded
                         self._reshard(op, i, block, n, s, merged,

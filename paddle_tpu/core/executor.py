@@ -670,6 +670,18 @@ def _lower(program, feed_names, fetch_names, donate=True, mesh=None,
                 raise ValueError('fetch var %s was never computed' % n)
             fetches.append(env[n])
         updates = {n: env[n] for n in writeback if n in env}
+        if mesh is not None:
+            # pin every annotated writeback layout (the shard pass's
+            # ZeRO specs included) so donated state comes back in the
+            # layout _gather_params expects — steady state skips the
+            # re-shard device_put entirely
+            from jax.sharding import NamedSharding
+            sh = program._sharding
+            for n in updates:
+                ps = sh.get(n)
+                if ps is not None:
+                    updates[n] = jax.lax.with_sharding_constraint(
+                        updates[n], NamedSharding(mesh, ps))
         probes = None
         if forensic is not None:
             vals = [env[n] for n in forensic.names() if n in env]
@@ -768,18 +780,22 @@ class _ExecEntry(object):
     lazily-specializing fallback kept for the rare input-spec drift an AOT
     executable cannot absorb (e.g. a scope param swapped to a new dtype).
     The strong `program` ref pins id(program) against recycling while the
-    entry lives."""
+    entry lives.  `shard_targets` (mesh launches only) maps each param to
+    the NamedSharding of the OPTIMIZED program — the shard pass rewrites
+    specs (ZeRO state sharding) on the optimizer twin, and gathering
+    against the raw program's specs would re-replicate every launch."""
     __slots__ = ('call', 'jit_fn', 'params_in', 'writeback', 'program',
-                 'fingerprint')
+                 'fingerprint', 'shard_targets')
 
     def __init__(self, call, jit_fn, params_in, writeback, program,
-                 fingerprint):
+                 fingerprint, shard_targets=None):
         self.call = call
         self.jit_fn = jit_fn
         self.params_in = params_in
         self.writeback = writeback
         self.program = program
         self.fingerprint = fingerprint
+        self.shard_targets = shard_targets
 
 
 def _tail_split_enabled():
@@ -1104,7 +1120,20 @@ class Executor(object):
                 _passes.config_token(), _emit.config_token(),
                 _kg_token())
 
-    def _gather_params(self, program, params_in, scope, base_key):
+    def _shard_targets_for(self, program, params_in):
+        """Param -> NamedSharding targets from `program._sharding`.
+        Called with the OPTIMIZED program at entry-resolution time so the
+        shard pass's rewritten specs (ZeRO accumulator/param sharding)
+        are what the scope arrays get device_put to."""
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = program._sharding
+        return {n: NamedSharding(self.mesh, spec.get(n, P()))
+                for n in params_in}
+
+    def _gather_params(self, program, params_in, scope, base_key,
+                       targets=None):
         import jax
         import jax.numpy as jnp
         params = {}
@@ -1133,12 +1162,10 @@ class Executor(object):
             # program's annotated layout.  Target shardings are cached per
             # lowering entry, and device_put is skipped once the written-
             # back arrays already carry the right sharding (steady state).
-            targets = self._shard_targets.get(base_key)
             if targets is None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                spec = program._sharding
-                targets = {n: NamedSharding(self.mesh, spec.get(n, P()))
-                           for n in params_in}
+                targets = self._shard_targets.get(base_key)
+            if targets is None:
+                targets = self._shard_targets_for(program, params_in)
                 self._shard_targets[base_key] = targets
             params = {n: (v if getattr(v, 'sharding', None) == targets[n]
                           else jax.device_put(v, targets[n]))
@@ -1158,8 +1185,9 @@ class Executor(object):
         if use_cache:
             entry = self._cache.get(hot_key)
             if entry is not None:
-                return entry, self._gather_params(program, entry.params_in,
-                                                  scope, base_key)
+                return entry, self._gather_params(
+                    program, entry.params_in, scope, base_key,
+                    targets=entry.shard_targets)
         # PT_LINT gate on the RAW program, BEFORE the rewriter: a user's
         # def-use/shape bug must be named here, not DCE'd out of sight
         from ..analysis import apply_lint_policy, lint_mode
@@ -1206,12 +1234,14 @@ class Executor(object):
             _obs.tracing.add_span(
                 'executor.lower', t_l0, time.perf_counter(), cat='compile',
                 args=dict(self._obs_tags, steps=steps) or None)
-        params = self._gather_params(program, params_in, scope, base_key)
+        shard_targets = self._shard_targets_for(opt_program, params_in)
+        params = self._gather_params(program, params_in, scope, base_key,
+                                     targets=shard_targets)
         if not use_cache:
             # cache bypass keeps the seed semantics: a lazily-retracing
             # jit call per run, observed by the explainer at call time
             return (_ExecEntry(jit_fn, jit_fn, params_in, writeback,
-                               program, None), params)
+                               program, None, shard_targets), params)
 
         call, fp, disk_tier = None, None, None
         if _cc.disk_enabled():
@@ -1325,7 +1355,8 @@ class Executor(object):
                 if tier and obs_on:
                     _obs.metrics.counter('compile_cache.store_s').inc(
                         time.perf_counter() - t_s0)
-        entry = _ExecEntry(call, jit_fn, params_in, writeback, program, fp)
+        entry = _ExecEntry(call, jit_fn, params_in, writeback, program, fp,
+                           shard_targets)
         self._cache.put(hot_key, entry)
         return entry, params
 

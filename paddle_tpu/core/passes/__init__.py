@@ -15,6 +15,10 @@ clone in place):
   const_fold        compile-time-constant chains -> one fill_constant,
                     evaluated through the op's own kernel (dtype-exact)
   cse               duplicate (type, inputs, attrs) ops rebind to one
+  shard             GSPMD-style partitioner (mesh-declared programs
+                    only): completes sharding specs, materializes D018
+                    edges as explicit reshard/grad_allreduce/all_gather
+                    collectives, ZeRO-shards optimizer state
   fuse_elementwise  consecutive elementwise/glue runs -> one
                     fused_elementwise op replaying the sub-program
   canon             64-bit attr narrowing + cross-block initializer dedup
@@ -22,6 +26,8 @@ clone in place):
 Environment:
   PT_OPT=1 (default) enables the pipeline; PT_OPT=0 is the kill switch.
   PT_OPT_SKIP=pass,pass disables individual passes by name.
+  PT_SHARD=1 (default) arms the shard pass (inert without a declared
+  mesh); PT_SHARD_ZERO=1 arms its optimizer-state sharding tier.
 
 Invariants: deterministic (same program -> same rewrite), idempotent
 (optimizing an optimized program is a no-op), `source_loc` preserved on
@@ -34,7 +40,7 @@ import os
 import time
 
 from . import walker  # noqa: F401  (re-exported for analysis/)
-from . import dce, const_fold, cse, fuse, canon
+from . import dce, const_fold, cse, fuse, canon, shard
 
 __all__ = ['enabled', 'skip_set', 'config_token', 'optimize_program',
            'maybe_optimize', 'pass_names', 'PASSES', 'walker']
@@ -43,6 +49,7 @@ PASSES = (
     ('dce', dce.run),
     ('const_fold', const_fold.run),
     ('cse', cse.run),
+    ('shard', shard.run),
     ('fuse_elementwise', fuse.run),
     ('canon', canon.run),
 )
@@ -68,7 +75,8 @@ def config_token():
     change instead of a mystery retrace."""
     if not enabled():
         return ('off',)
-    return ('on',) + tuple(sorted(skip_set() & set(pass_names())))
+    return (('on',) + tuple(sorted(skip_set() & set(pass_names())))
+            + shard.config_token())
 
 
 class PassCtx(object):
@@ -175,6 +183,14 @@ def maybe_optimize(program, fetch_names=()):
     opt, stats = optimize_program(program, fetch_names)
     from ... import observability as _obs
     if _obs.enabled():
+        shard_stats = stats['passes'].get('shard') or {}
+        if shard_stats.get('reshards_inserted') or \
+                shard_stats.get('grad_allreduce') or \
+                shard_stats.get('all_gathers'):
+            _obs.metrics.counter('opt.reshards_inserted').inc(
+                shard_stats['reshards_inserted'])
+            _obs.metrics.counter('opt.collective_bytes').inc(
+                shard_stats.get('collective_bytes', 0))
         _obs.metrics.counter('opt.ops_removed').inc(stats['ops_removed'])
         _obs.metrics.counter('opt.ops_fused').inc(stats['ops_fused'])
         _obs.metrics.counter('opt.pass_ms').inc(stats['pass_ms'])

@@ -1,0 +1,571 @@
+"""GSPMD-style partitioner pass: sharding specs drive the lowering.
+
+PR-19 made sharding first-class IR state (`Variable.sharding`, the
+D017-D021 lints, the memplan HBM planner) but the specs stayed inert:
+the executor replicated every parameter and GSPMD inserted whatever
+implicit collectives it liked.  This pass is the closing move (ROADMAP
+item 1): it turns declared specs into an executed partitioning with
+explicit, fused collectives per the memory-efficient array-
+redistribution cost model (arxiv 2112.01075).
+
+Runs in the PT_OPT pipeline (after cse, before fuse_elementwise) when a
+mesh is declared on the program (`Program.set_mesh_axes`); `PT_SHARD=0`
+or `PT_OPT_SKIP=shard` disables it.  Phases, on the root block:
+
+  complete   propagate declared specs forward with the SAME transfer
+             rules as the D017/D018 analyzer and write the inferred
+             spec onto every unannotated produced var — lint, memplan,
+             and the lowering's in/out shardings all see one answer
+  zero       ZeRO-style optimizer-state sharding (PT_SHARD_ZERO=1):
+             each eligible parameter's accumulators (and, when the
+             parameter is only read by the forward + its own update
+             op, the parameter storage itself) get the parameter's
+             spec additionally sharded over the data axis on dim 0;
+             an explicit `all_gather` rejoins the full layout at the
+             first forward consumer — only where a consumer demands it
+  grads      rewrite the `__backward__` seam: one explicit
+             `grad_allreduce` per parameter, dst = the parameter's
+             (possibly ZeRO-sharded) spec, so the gradient reduction
+             happens exactly once and a ZeRO dst collapses
+             all-reduce+scatter into a single reduce-scatter
+  reshard    every remaining D018 edge (dataflow delivers one layout,
+             the consumer/annotation demands another) materializes as
+             an explicit `reshard` op carrying src/dst specs and the
+             estimated bytes — the same `_var_bytes` the D018 lint
+             reports, so analyzer and rewriter cannot drift
+  fuse       adjacent collectives on single-consumer edges collapse to
+             one op (reshard-of-reshard; all-gather-then-reduce pairs
+             become one grad_allreduce)
+
+Everything the pass inserts stays visible as an explicit op in the
+optimized program (collectives are not FUSABLE_OPS), and every kernel
+is the identity off-mesh — the same optimized program runs bitwise-
+identically on a single device, which is what the parity tests pin.
+"""
+import os
+
+from ..framework import Parameter
+from ..sharding import (normalize_spec, spec_axes, spec_to_jsonable,
+                        spec_from_jsonable)
+
+__all__ = ['run', 'enabled', 'active_for', 'zero_enabled', 'zero_axis',
+           'plan_zero_specs', 'COLLECTIVE_OPS']
+
+COLLECTIVE_OPS = ('reshard', 'all_gather', 'grad_allreduce')
+
+# optimizer update ops (ops/optimizer_ops.py): Param/Grad in,
+# ParamOut out, persistable accumulator state threaded through
+_OPT_UPDATE_OPS = {
+    'sgd', 'momentum', 'lars_momentum', 'adam', 'adamax', 'adagrad',
+    'decayed_adagrad', 'adadelta', 'rmsprop', 'ftrl', 'lamb',
+}
+
+_BACKWARD_OP = '__backward__'
+
+
+def enabled():
+    return os.environ.get('PT_SHARD', '1') not in ('0', 'false', 'False')
+
+
+def zero_enabled():
+    return os.environ.get('PT_SHARD_ZERO', '1') not in ('0', 'false',
+                                                        'False')
+
+
+def zero_axis():
+    return os.environ.get('PT_SHARD_ZERO_AXIS', 'data')
+
+
+def config_token():
+    """The shard-pass component of passes.config_token(): part of the
+    executor's hot key and the launch signature, so flipping PT_SHARD /
+    PT_SHARD_ZERO mid-process reads as a named change."""
+    if not enabled():
+        return ('shard_off',)
+    return ('shard_on', 'zero' if zero_enabled() else 'nozero',
+            zero_axis())
+
+
+def active_for(program):
+    """Whether the pass will rewrite THIS program: pipeline on, pass not
+    skipped, PT_SHARD on, and a mesh declared.  memplan uses this to
+    decide whether the ZeRO divisor applies to the per-device plan."""
+    from . import enabled as _opt_enabled, skip_set
+    return (enabled() and _opt_enabled() and 'shard' not in skip_set()
+            and bool(program.mesh_axes()))
+
+
+# ------------------------------------------------------------ analysis
+def _analysis_rules():
+    """The D017/D018 analyzer's transfer-rule surface — imported lazily
+    (analysis imports core.passes.walker; a top-level import here would
+    cycle) and shared so the rewriter cannot drift from the lint."""
+    from ...analysis.passes import sharding as az
+    return az
+
+
+def _declared(block, name):
+    v = block._find_var_recursive(name)
+    return v._sharding_spec if v is not None else None
+
+
+def _trim(spec):
+    """Strip redundant trailing None entries (PartitionSpec semantics)."""
+    spec = tuple(spec or ())
+    while spec and spec[-1] is None:
+        spec = spec[:-1]
+    return spec
+
+
+def _eqspec(a, b):
+    """Layout equality up to trailing replication — shared semantics
+    with the analyzer's D018 comparison."""
+    return _trim(a) == _trim(b)
+
+
+def _pad(spec, rank):
+    """Spec padded with None entries to `rank` (PartitionSpec semantics:
+    trailing dims are replicated)."""
+    spec = tuple(spec or ())
+    if rank is None or len(spec) >= rank:
+        return spec
+    return spec + (None,) * (rank - len(spec))
+
+
+class _Propagator(object):
+    """Forward spec propagation over the root block with the analyzer's
+    transfer functions, plus the collective-op rule (out = dst_spec).
+    `on_mismatch(op_index, op, name, have, want, kind)` fires exactly
+    where the analyzer would report D018."""
+
+    def __init__(self, program, on_mismatch=None):
+        self.az = _analysis_rules()
+        self.program = program
+        self.block = program.global_block()
+        self.env = {}
+        self.on_mismatch = on_mismatch or (lambda *a, **k: None)
+        for name, v in self.block.vars.items():
+            if v._sharding_spec is not None:
+                self.env[name] = v._sharding_spec
+
+    def in_spec(self, name):
+        if name in self.env:
+            return self.env[name]
+        return _declared(self.block, name)
+
+    def walk(self):
+        for i, op in enumerate(list(self.block.ops)):
+            self.step(i, op)
+        return self.env
+
+    def step(self, i, op):
+        block = self.block
+        if op.attrs.get('sub_block') is not None:
+            for n in op.output_names():
+                self._finish(i, op, n, None)
+            return
+        if op.type == _BACKWARD_OP:
+            pnames = op.attrs.get('params', ())
+            for slot, names in op.outputs.items():
+                if slot == 'Grads':
+                    for p, g in zip(pnames, names):
+                        self._finish(i, op, g, self.in_spec(p))
+                else:
+                    for n in names:
+                        self._finish(i, op, n, None)
+            return
+        out_specs = self._propagate(i, op)
+        for n in op.output_names():
+            self._finish(i, op, n, out_specs.get(n))
+
+    def _propagate(self, i, op):
+        az = self.az
+        outs = {}
+        first_out = (op.outputs.get('Out') or [None])[0]
+        if op.type in COLLECTIVE_OPS:
+            if first_out is not None:
+                outs[first_out] = normalize_spec(
+                    spec_from_jsonable(op.attrs.get('dst_spec')))
+            return outs
+        if op.type in az._SAME_LAYOUT:
+            merged = None
+            for slot in ('X', 'Y'):
+                for n in op.inputs.get(slot, ()):
+                    s = self.in_spec(n)
+                    if s is None:
+                        continue
+                    if merged is None:
+                        merged = s
+                    elif not _eqspec(s, merged):
+                        self.on_mismatch(i, op, n, s, merged, 'input')
+            if first_out is not None:
+                outs[first_out] = merged
+        elif op.type in az._MATMUL:
+            xs = [self.in_spec(n) for n in op.inputs.get('X', ())]
+            wnames = op.inputs.get('Y', ()) or op.inputs.get('W', ())
+            ws = [self.in_spec(n) for n in wnames]
+            x = xs[0] if xs else None
+            w = ws[0] if ws else None
+            if x is not None and w is not None and len(x) >= 1 and \
+                    len(w) >= 1 and x[-1] is not None and \
+                    w[0] is not None and x[-1] != w[0]:
+                self.on_mismatch(i, op, wnames[0], w,
+                                 (x[-1],) + tuple(w[1:]), 'contraction')
+            if first_out is not None:
+                if x is not None and len(x) >= 1:
+                    tail = (w[-1],) if w is not None and len(w) >= 1 \
+                        else (None,)
+                    outs[first_out] = tuple(x[:-1]) + tail
+                elif w is not None:
+                    outs[first_out] = None
+        elif op.type in ('transpose', 'transpose2'):
+            perm = op.attrs.get('axis') or op.attrs.get('perm')
+            src = (op.inputs.get('X') or [None])[0]
+            s = self.in_spec(src) if src else None
+            if s is not None and perm and len(perm) == len(s) and \
+                    first_out is not None:
+                outs[first_out] = tuple(s[p] for p in perm)
+        return outs
+
+    def _finish(self, i, op, name, spec):
+        declared = _declared(self.block, name)
+        if declared is not None:
+            if spec is not None and not _eqspec(spec, declared):
+                self.on_mismatch(i, op, name, spec, declared, 'producer')
+            spec = declared
+        self.env[name] = spec
+
+
+# --------------------------------------------------------- ZeRO planning
+def _accumulators_of(block, op):
+    """Persistable non-Param inputs of an optimizer update op whose shape
+    matches the parameter's — the moment/velocity state ZeRO shards.
+    Scalar state (beta-pow counters, LR) falls out via the shape test."""
+    p = (op.inputs.get('Param') or [None])[0]
+    pv = block._find_var_recursive(p) if p else None
+    if pv is None or pv.shape is None:
+        return p, pv, []
+    accs = []
+    for slot, names in op.inputs.items():
+        if slot in ('Param', 'Grad', 'LearningRate'):
+            continue
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is not None and v is not pv and v.persistable and \
+                    v.shape is not None and tuple(v.shape) == \
+                    tuple(pv.shape):
+                accs.append(v)
+    return p, pv, accs
+
+
+def plan_zero_specs(program, env=None):
+    """{var name: canonical spec} of the ZeRO-sharded layout this pass
+    would apply — parameters and their accumulators, each with the
+    parameter's spec additionally split over the data axis on dim 0.
+
+    Pure analysis of the (raw or optimized) program: memplan calls this
+    to divide the per-device plan by the same math the rewriter applies,
+    so the footprint table and the executed partitioning cannot drift.
+    Returns ({name: spec}, {param: accumulator names}).
+    """
+    mesh_axes = program.mesh_axes()
+    axis = zero_axis()
+    if not zero_enabled() or not mesh_axes or axis not in mesh_axes:
+        return {}, {}
+    size = int(mesh_axes[axis])
+    if size <= 1:
+        return {}, {}
+    block = program.global_block()
+    ops = block.ops
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == _BACKWARD_OP), None)
+    specs, state = {}, {}
+    for ui, op in enumerate(ops):
+        if op.type not in _OPT_UPDATE_OPS:
+            continue
+        p, pv, accs = _accumulators_of(block, op)
+        if pv is None or pv.shape is None or not pv.shape:
+            continue
+        base = _pad(env.get(p) if env else pv._sharding_spec,
+                    len(pv.shape))
+        if axis in spec_axes(base):
+            continue  # already split over the data axis somewhere
+        if base[0] is not None or int(pv.shape[0]) % size != 0:
+            continue  # dim 0 taken or not evenly divisible
+        zspec = (axis,) + tuple(base[1:])
+        # parameter storage shards too, but ONLY when every post-backward
+        # reader is this update op itself (the forward gets an explicit
+        # all_gather; an unexpected reader would silently see the shard)
+        shard_param = bw_idx is not None
+        if shard_param:
+            for oi, other in enumerate(ops):
+                if oi <= bw_idx or other is op:
+                    continue
+                reads = set(other.input_names()) | \
+                    set(other.attrs.get('params', ()))
+                if p in reads or other.attrs.get('sub_block') is not None:
+                    shard_param = False
+                    break
+        if shard_param:
+            specs[p] = zspec
+        for v in accs:
+            specs[v.name] = zspec
+        state[p] = [v.name for v in accs]
+    return specs, state
+
+
+# ----------------------------------------------------------- rewriting
+def _mk_var(block, like, name, spec):
+    v = block.create_var(name=name, dtype=like.dtype, shape=like.shape,
+                         persistable=False)
+    v.stop_gradient = getattr(like, 'stop_gradient', False)
+    if spec is not None:
+        v.sharding = spec
+    return v
+
+
+def _mk_collective(block, kind, src_name, dst_name, src_spec, dst_spec,
+                   bytes_, extra=None):
+    from ..framework import Operator
+    attrs = {'src_spec': spec_to_jsonable(tuple(src_spec or ())),
+             'dst_spec': spec_to_jsonable(tuple(dst_spec or ())),
+             'bytes': int(bytes_)}
+    attrs.update(extra or {})
+    op = Operator(block, kind, inputs={'X': src_name},
+                  outputs={'Out': dst_name}, attrs=attrs)
+    return op
+
+
+def _rewire_inputs(op, old, new):
+    changed = False
+    for slot, names in op.inputs.items():
+        if old in names:
+            op.inputs[slot] = [new if n == old else n for n in names]
+            changed = True
+    return changed
+
+
+def _insert(block, idx, op):
+    block.ops.insert(idx, op)
+    for n in op.output_names():
+        v = block._find_var_recursive(n)
+        if v is not None:
+            v.op = op
+
+
+def _bytes_of(block, name, have, mesh_axes):
+    return _analysis_rules()._var_bytes(block, name, have, mesh_axes)
+
+
+def run(program, ctx):
+    stats = {'specs_completed': 0, 'reshards_inserted': 0,
+             'grad_allreduce': 0, 'all_gathers': 0, 'zero_params': 0,
+             'zero_state_vars': 0, 'collectives_fused': 0,
+             'collective_bytes': 0}
+    mesh_axes = program.mesh_axes()
+    if not enabled() or not mesh_axes:
+        return stats
+    block = program.global_block()
+
+    def _complete():
+        wrote = 0
+        for name, spec in _Propagator(program).walk().items():
+            if spec is None:
+                continue
+            v = block.vars.get(name)
+            if v is None or v._sharding_spec is not None:
+                continue
+            if v.shape is not None and len(spec) > len(v.shape):
+                continue  # rank overflow is the analyzer's D017, not ours
+            v.sharding = spec
+            wrote += 1
+        stats['specs_completed'] += wrote
+        return wrote
+
+    # ---- complete: write propagated specs onto unannotated vars
+    _complete()
+    env = _Propagator(program).walk()
+    persist = ctx.persistable
+
+    ops = block.ops
+    bw_idx = next((i for i, op in enumerate(ops)
+                   if op.type == _BACKWARD_OP), None)
+
+    # ---- zero: optimizer-state (and param-storage) sharding
+    zspecs, zstate = plan_zero_specs(program, env)
+    existing_gathers = {(op.inputs.get('X') or [None])[0]
+                        for op in ops if op.type == 'all_gather'}
+    gather_plan = []  # (first_use_idx, param, base_spec)
+    for name, zspec in zspecs.items():
+        v = block.vars.get(name)
+        if v is None:
+            continue
+        is_param = isinstance(v, Parameter)
+        base = _pad(env.get(name), len(v.shape or ()))
+        if v._sharding_spec != zspec:
+            v.sharding = zspec
+        env[name] = zspec
+        if is_param:
+            stats['zero_params'] += 1
+            if bw_idx is not None and name not in existing_gathers:
+                first = next(
+                    (i for i, op in enumerate(ops[:bw_idx])
+                     if name in op.input_names()), None)
+                if first is not None:
+                    gather_plan.append((first, name, base))
+        else:
+            stats['zero_state_vars'] += 1
+    # insert gathers back-to-front so earlier indices stay valid
+    for first, name, base in sorted(gather_plan, reverse=True):
+        v = block.vars[name]
+        full = _mk_var(block, v, name + '@FULL', tuple(base))
+        g = _mk_collective(block, 'all_gather', name, full.name,
+                           zspecs[name], base,
+                           _bytes_of(block, name, zspecs[name],
+                                     mesh_axes))
+        g.attrs['rng_stream'] = ops[first].attrs.get('rng_stream', first)
+        for op in ops[first:bw_idx]:
+            _rewire_inputs(op, name, full.name)
+        _insert(block, first, g)
+        env[full.name] = tuple(base)
+        stats['all_gathers'] += 1
+        stats['collective_bytes'] += g.attrs['bytes']
+        bw_idx += 1
+
+    # ---- grads: one explicit grad_allreduce per parameter
+    if bw_idx is not None:
+        bw_op = ops[bw_idx]
+        pnames = list(bw_op.attrs.get('params', ()))
+        gnames = list(bw_op.outputs.get('Grads', ()))
+        reduced = {(op.inputs.get('X') or [None])[0]
+                   for op in ops if op.type == 'grad_allreduce'}
+        sub_reads = set()
+        for b in program.blocks:
+            if b.idx != 0:
+                for op in b.ops:
+                    sub_reads.update(op.input_names())
+        insert_at = bw_idx + 1
+        for p, g in zip(pnames, gnames):
+            if g in reduced or g in sub_reads or g in persist:
+                continue
+            gv = block._find_var_recursive(g)
+            if gv is None:
+                continue
+            dst = zspecs.get(p, env.get(p))
+            src = env.get(g)
+            ar = _mk_var(block, gv, g + '@AR', dst)
+            extra = {'param': p}
+            if zero_axis() in mesh_axes:
+                extra['axis_name'] = zero_axis()
+            arop = _mk_collective(block, 'grad_allreduce', g, ar.name,
+                                  src, dst,
+                                  _bytes_of(block, g, src, mesh_axes),
+                                  extra)
+            arop.attrs['rng_stream'] = bw_op.attrs.get('rng_stream',
+                                                       bw_idx)
+            for op in ops[insert_at:]:
+                _rewire_inputs(op, g, ar.name)
+            _insert(block, insert_at, arop)
+            env[ar.name] = dst
+            insert_at += 1
+            stats['grad_allreduce'] += 1
+            stats['collective_bytes'] += arop.attrs['bytes']
+
+    # ---- reshard: materialize every remaining D018 edge
+    edges = []
+
+    def on_mismatch(i, op, name, have, want, kind):
+        edges.append((i, op, name, tuple(have or ()), tuple(want or ()),
+                      kind))
+
+    _Propagator(program, on_mismatch).walk()
+    # apply back-to-front so recorded indices stay valid
+    n_rs = 0
+    for i, op, name, have, want, kind in sorted(
+            edges, key=lambda e: e[0], reverse=True):
+        v = block._find_var_recursive(name)
+        if v is None:
+            continue
+        if v.shape is not None and len(want) > len(v.shape):
+            want = want[:len(v.shape)]  # trailing entries are replication
+        if _eqspec(have, want):
+            continue
+        by = _bytes_of(block, name, have, mesh_axes)
+        if kind == 'producer':
+            # the producing op's dataflow layout disagrees with the
+            # declared annotation: route the producer through a fresh
+            # var and reshard into the annotated name
+            if sum(1 for n in op.output_names() if n == name) != 1:
+                continue
+            src = _mk_var(block, v, name + '@SRC%d' % n_rs, have)
+            for slot, names in op.outputs.items():
+                if name in names:
+                    op.outputs[slot] = [src.name if n == name else n
+                                        for n in names]
+            src.op = op
+            rs = _mk_collective(block, 'reshard', src.name, name, have,
+                                want, by)
+            rs.attrs['rng_stream'] = op.attrs.get('rng_stream', i)
+            _insert(block, i + 1, rs)
+        else:
+            # a consumer needs `name` in a different layout: reshard
+            # into a fresh var read only by THIS op
+            dst = _mk_var(block, v, name + '@RS%d' % n_rs, want)
+            rs = _mk_collective(block, 'reshard', name, dst.name, have,
+                                want, by)
+            rs.attrs['rng_stream'] = op.attrs.get('rng_stream', i)
+            _rewire_inputs(op, name, dst.name)
+            _insert(block, i, rs)
+        n_rs += 1
+        stats['reshards_inserted'] += 1
+        stats['collective_bytes'] += rs.attrs['bytes']
+
+    # ---- fuse: collapse adjacent collectives on single-consumer edges
+    readers = {}
+    for op in block.ops:
+        for n in op.input_names():
+            readers.setdefault(n, []).append(op)
+    i = 0
+    while i < len(block.ops):
+        a = block.ops[i]
+        if a.type not in COLLECTIVE_OPS:
+            i += 1
+            continue
+        out = (a.outputs.get('Out') or [None])[0]
+        rs = readers.get(out, [])
+        if out in persist or out in ctx.fetch_names or len(rs) != 1 or \
+                rs[0].type not in COLLECTIVE_OPS:
+            i += 1
+            continue
+        b = rs[0]
+        # reduce-then-X and all-gather-then-reduce both keep the
+        # reduction; pure layout chains stay a reshard
+        kind = 'grad_allreduce' \
+            if 'grad_allreduce' in (a.type, b.type) else \
+            ('all_gather' if b.type == 'all_gather' else 'reshard')
+        b.type = kind
+        src_name = (a.inputs.get('X') or [None])[0]
+        _rewire_inputs(b, out, src_name)
+        b.attrs['src_spec'] = a.attrs.get('src_spec')
+        b.attrs['bytes'] = int(a.attrs.get('bytes', 0))
+        if a.attrs.get('param') and not b.attrs.get('param'):
+            b.attrs['param'] = a.attrs['param']
+        block.ops.pop(i)
+        block.vars.pop(out, None)
+        program._sharding.pop(out, None)
+        readers = {}
+        for op in block.ops:
+            for n in op.input_names():
+                readers.setdefault(n, []).append(op)
+        stats['collectives_fused'] += 1
+        program._bump()
+
+    # ---- final sweep: the rewrites above unlock more inferences (grad
+    # vars inherit ZeRO'd param specs); writing them now keeps the pass
+    # idempotent — a second run finds nothing left to complete
+    _complete()
+
+    if stats['reshards_inserted'] or stats['grad_allreduce'] or \
+            stats['all_gathers'] or stats['specs_completed']:
+        program._bump()
+    return stats

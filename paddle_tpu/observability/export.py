@@ -231,6 +231,13 @@ SCHEMA.update({
         ('allreduce_gbps', ('timing', 'higher', 'GB/s')),
         ('steps_per_s_1worker', ('timing', 'higher', 'steps/s')),
         ('scaling_2worker_x', ('timing', 'higher', 'x')),
+        # shard-pass round: explicit-collective accounting + per-device
+        # persistable HBM, replicated vs ZeRO-sharded in one record
+        ('reshards_inserted', ('counter', 'lower')),
+        ('collective_bytes', ('counter', 'lower')),
+        ('hbm_sharded_ratio', ('timing', 'lower', 'x')),
+        ('hbm_params_bytes_replicated', ('info',)),
+        ('hbm_params_bytes_sharded', ('info',)),
         ('devices', ('info',)),
     ),
     'perflab.fused_adam_micro': (

@@ -82,6 +82,13 @@ class Optimizer(object):
             name=unique_name.generate('%s_%s' % (param.name, name)),
             shape=shape, dtype=dtype or param.dtype, persistable=True,
             stop_gradient=True)
+        # same-shaped state inherits the parameter's declared layout:
+        # a model-parallel annotation covers its moments without the
+        # user re-annotating, and the shard pass's ZeRO tier then splits
+        # both identically
+        if param.sharding is not None and tuple(shape) == \
+                tuple(param.shape or ()):
+            var.sharding = param.sharding
         Constant(value=float(fill_value))(var)
         self._accumulators[(name, param.name)] = var
         return var

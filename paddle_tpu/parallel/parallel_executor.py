@@ -4,8 +4,17 @@ Parity: reference python/paddle/fluid/parallel_executor.py + C++
 framework/details/ SSA-graph executor.  The reference clones the graph per
 GPU and threads NCCL all_reduce ops between them; here the SAME lowered
 XLA computation runs SPMD: feeds are sharded on the batch dim over the
-'data' mesh axis, parameters are replicated, and GSPMD emits gradient
-all-reduces over ICI automatically.  `exe.run()` is still one device launch.
+'data' mesh axis and `exe.run()` is still one device launch.
+
+Constructing a ParallelExecutor declares the mesh on the main program
+(`Program.set_mesh_axes`), which arms the GSPMD-style shard pass
+(core/passes/shard.py): sharding specs complete over the whole program,
+every gradient reduction becomes one explicit `grad_allreduce` op,
+optimizer state is ZeRO-sharded over the data axis (PT_SHARD_ZERO=1,
+the default), and every remaining layout seam is an explicit `reshard`
+carrying its estimated bytes — nothing is blanket-replicated and no
+collective is implicit.  `PT_SHARD=0` restores the old behavior
+(parameters replicated, GSPMD inserts whatever it likes).
 """
 import numpy as np
 
@@ -26,6 +35,11 @@ class ParallelExecutor(object):
         self._scope = scope or global_scope()
         import jax
         self._mesh = mesh or make_mesh(data=len(jax.devices()))
+        # declare the mesh on the program: this is what arms the shard
+        # pass (an already-declared mesh — e.g. a deliberately different
+        # logical layout — wins)
+        if self._main_program.mesh_axes() is None:
+            self._main_program.set_mesh_axes(self._mesh)
         self._exe = Executor(mesh=self._mesh)
         # tag every span this executor records with the mesh/shard layout,
         # so a timeline mixing single-chip and mesh launches stays legible

@@ -258,10 +258,12 @@ class Checkpointer(object):
             return {'axes': [], 'shape': []}
 
     def _sharding_info(self):
-        """Per-var PartitionSpec annotations (Program.set_sharding) as
-        JSON — placement metadata travels with the artifact, not in
-        runtime state, so a differently-meshed restorer can re-derive
-        its own slicing."""
+        """Per-var PartitionSpec annotations (Variable.sharding) in the
+        canonical spec_to_jsonable form — placement metadata travels
+        with the artifact, not in runtime state, so a differently-meshed
+        restorer can adopt the specs verbatim (restore() writes them
+        back onto the program, counted as ckpt.sharding_adopted)."""
+        from ..core.sharding import normalize_spec, spec_to_jsonable
         prog = self.main_program
         sh = getattr(prog, '_sharding', None) if prog is not None else None
         if not sh:
@@ -269,10 +271,9 @@ class Checkpointer(object):
         out = {}
         for name, spec in sh.items():
             try:
-                out[name] = [None if p is None else str(p)
-                             for p in tuple(spec)]
-            except TypeError:
-                out[name] = [str(spec)]
+                out[name] = spec_to_jsonable(normalize_spec(spec))
+            except Exception:
+                continue
         return out
 
     def note_progress(self, epoch_id, step_id, extra_meta=None):
@@ -765,6 +766,8 @@ class Checkpointer(object):
                     else:
                         arrays[n][int(sh['start']):int(sh['stop'])] = piece
         meta = dict(man['meta'])
+        # stash the manifest's placement table for restore() to adopt
+        self._restored_sharding = man.get('sharding') or {}
         cur_mesh = self._mesh_info()
         cur_writers = list(range(self.config.host_count))
         if man.get('mesh') != cur_mesh or \
@@ -778,6 +781,35 @@ class Checkpointer(object):
                           'from_hosts': len(man.get('writers') or []),
                           'to_hosts': self.config.host_count})
         return arrays, meta
+
+    def _adopt_sharding(self):
+        """Write the restored manifest's PartitionSpecs back onto the
+        CURRENT program's vars (Variable.sharding, which syncs
+        Program._sharding and re-arms the shard pass) — the placement an
+        elastic restore resumes under is the one the artifact recorded,
+        not whatever the fresh program happened to declare.  Returns the
+        number of vars whose spec actually changed."""
+        sh = getattr(self, '_restored_sharding', None)
+        if not sh or self.main_program is None:
+            return 0
+        from ..core.sharding import normalize_spec, spec_from_jsonable
+        block = self.main_program.global_block()
+        adopted = 0
+        for name, jsonable in sh.items():
+            v = block._find_var_recursive(name)
+            if v is None:
+                continue
+            try:
+                spec = normalize_spec(spec_from_jsonable(jsonable))
+            except Exception:
+                continue
+            if spec is None or v.sharding == spec:
+                continue
+            if v.shape is not None and len(spec) > len(v.shape):
+                continue   # rank overflow: leave it to the D017 lint
+            v.sharding = spec
+            adopted += 1
+        return adopted
 
     def restore(self):
         """Load the newest COMPLETE checkpoint (torn ones — no SUCCESS
@@ -799,6 +831,7 @@ class Checkpointer(object):
                     if v.persistable}
         for s in reversed(self._serials()):
             ckpt = self._dir_of(s)
+            self._restored_sharding = {}
             try:
                 if os.path.exists(os.path.join(ckpt, _MANIFEST)):
                     arrays, meta = self._load_sharded(ckpt, keep)
@@ -810,6 +843,9 @@ class Checkpointer(object):
                 continue
             for n, a in arrays.items():
                 scope.set(n, a)
+            adopted = self._adopt_sharding()
+            if adopted:
+                _obs.metrics.counter('ckpt.sharding_adopted').inc(adopted)
             rng = meta.get('rng_state')
             if rng and callable(getattr(self.executor, 'set_rng_state',
                                         None)):

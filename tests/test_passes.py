@@ -8,6 +8,7 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.core import passes
+from paddle_tpu.core.passes import shard
 
 
 def _op_types(program):
@@ -307,7 +308,8 @@ def test_pt_opt_skip_selectivity(monkeypatch):
     assert 'fuse_elementwise' not in stats['passes']
     assert stats['passes']['dce']['ops_removed'] == 1   # dce still ran
     assert 'fused_elementwise' not in _op_types(opt)
-    assert passes.config_token() == ('on', 'fuse_elementwise')
+    assert passes.config_token() == \
+        ('on', 'fuse_elementwise') + shard.config_token()
 
 
 def test_maybe_optimize_memoizes(monkeypatch):

@@ -97,6 +97,106 @@ if [ "$opt_gate_rc" -ne 0 ]; then
     echo "ci_smoke: opt pipeline gate FAILED (rc=$opt_gate_rc)"
 fi
 
+echo "== ci_smoke: shard pass — 2-device mesh bitwise parity =="
+# the GSPMD-style partitioner gate (docs/passes.md, shard pass): the
+# bench transformer on a 2-device CPU mesh with PT_SHARD=1 must (a)
+# train bitwise-equal to the SAME optimized program on a single device
+# (replicated feeds; ZeRO-sharded params + Adam state on the mesh side),
+# (b) lint clean of D017 after the rewrite, and (c) insert a stable set
+# of collectives — two optimize runs, identical reshards_inserted and
+# collective_bytes
+timeout -k 10 600 env JAX_PLATFORMS=cpu PT_CACHE=0 PT_KERNELGEN=0 \
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    python - <<'EOF'
+import sys
+
+import numpy as np
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.core import passes
+from paddle_tpu.analysis import lint_program
+from paddle_tpu.models import transformer as tr
+from paddle_tpu.parallel.mesh import make_mesh
+
+def build(B=2, T=16):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            out = tr.build(src_vocab=256, trg_vocab=256, max_len=T,
+                           n_layer=2, n_head=2, d_model=32, d_inner=64,
+                           dropout=0.1, use_flash=False)
+    main.set_amp(True)
+    main.set_mesh_axes({'data': 2})
+    # replicated feeds: the sharded run sees the SAME global batch as
+    # the single-device run, so parity is bitwise, not allclose
+    for v in main.global_block().vars.values():
+        if getattr(v, 'is_data', False) and v.shape is not None:
+            v.sharding = (None,) * len(v.shape)
+    return main, startup, out
+
+main, _, out = build()
+fetch = (out['loss'].name,)
+opt1, stats1 = passes.optimize_program(main, fetch)
+opt2, stats2 = passes.optimize_program(main, fetch)
+s1, s2 = stats1['passes']['shard'], stats2['passes']['shard']
+for k in ('reshards_inserted', 'collective_bytes', 'grad_allreduce',
+          'all_gathers'):
+    if s1[k] != s2[k]:
+        sys.exit('ci_smoke: shard pass unstable across runs: %s %r vs %r'
+                 % (k, s1[k], s2[k]))
+if not (s1['grad_allreduce'] or s1['all_gathers']
+        or s1['reshards_inserted']):
+    sys.exit('ci_smoke: shard pass inserted no collectives on a meshed '
+             'transformer — the partitioner is not running')
+res = lint_program(opt1, fetch_names=fetch)
+d17 = [d for d in res.diagnostics if d.code == 'D017']
+if d17:
+    sys.exit('ci_smoke: D017 on the shard-optimized transformer: %s'
+             % [d.message[:90] for d in d17[:3]])
+print('ci_smoke: shard pass stable (%d grad_allreduce, %d all_gather, '
+      '%d reshard, %d bytes), zero D017'
+      % (s1['grad_allreduce'], s1['all_gathers'], s1['reshards_inserted'],
+         s1['collective_bytes']))
+
+def train(mesh):
+    main, startup, out = build()
+    exe = fluid.Executor(mesh=make_mesh(data=2) if mesh else None)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = tr.synthetic_batch(rng, 2, 16, 256)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[out['loss']])[0])
+                  for _ in range(2)]
+        state = {n: np.asarray(v) for n, v in scope.vars.items()}
+    return losses, state
+
+lm, sm = train(True)
+ls, ss = train(False)
+for a, b in zip(lm, ls):
+    if not np.array_equal(a, b):
+        sys.exit('ci_smoke: sharded losses diverge from single-device: '
+                 '%r vs %r' % (a, b))
+# state keys differ only by the fresh unique-name counter per build;
+# compare sorted positionally (same build => same order)
+if len(sm) != len(ss):
+    sys.exit('ci_smoke: sharded run state count %d != single-device %d'
+             % (len(sm), len(ss)))
+bad = [n1 for (n1, a), (n2, b)
+       in zip(sorted(sm.items()), sorted(ss.items()))
+       if not np.array_equal(a, b)]
+if bad:
+    sys.exit('ci_smoke: sharded end-of-run state diverges: %s' % bad[:5])
+print('ci_smoke: PT_SHARD=1 2-device mesh bitwise-equal to '
+      'single-device (%d steps, %d state arrays)' % (len(lm), len(sm)))
+EOF
+shard_rc=$?
+if [ "$shard_rc" -ne 0 ]; then
+    echo "ci_smoke: shard pass gate FAILED (rc=$shard_rc)"
+fi
+
 echo "== ci_smoke: strict-emit zoo coverage =="
 # direct-emitter gate, part 1 (docs/emitter.md): every zoo program must
 # be fully emit-capable — zero D015 lint findings, an EmitEngine builds
@@ -839,7 +939,8 @@ fi
 [ "$t1_rc" -eq 0 ] && [ "$schema_rc" -eq 0 ] && [ "$lint_rc" -eq 0 ] && \
     [ "$lint_schema_rc" -eq 0 ] && \
     [ "$ruff_rc" -eq 0 ] && [ "$opt_lint_rc" -eq 0 ] && \
-    [ "$opt_gate_rc" -eq 0 ] && [ "$emit_zoo_rc" -eq 0 ] && \
+    [ "$opt_gate_rc" -eq 0 ] && [ "$shard_rc" -eq 0 ] && \
+    [ "$emit_zoo_rc" -eq 0 ] && \
     [ "$kg_zoo_rc" -eq 0 ] && [ "$autotune_rc" -eq 0 ] && \
     [ "$soak_rc" -eq 0 ] && \
     [ "$resume_rc" -eq 0 ] && [ "$async_rc" -eq 0 ] && \

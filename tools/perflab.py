@@ -388,14 +388,94 @@ def scenario_decode_stream(best_of):
     return metrics, {'tokens_per_s_per_chip': [round(tps, 1)]}, config
 
 
+def _pod_shard_round():
+    """Replicated-vs-ZeRO-sharded in one round on the local mesh: per-
+    device persistable HBM (via addressable_shards, not the cost model)
+    plus the shard pass's explicit-collective accounting.  Returns {}
+    below 2 devices — the schema keys then stay absent, which the gate
+    treats as not-measured rather than regressed."""
+    import jax
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.core import passes
+    from paddle_tpu.parallel.mesh import make_mesh
+
+    if jax.local_device_count() < 2:
+        return {}
+    mesh = make_mesh(data=2, devices=jax.devices()[:2])
+
+    def build():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data('ps_x', shape=[64], dtype='float32')
+                h = fluid.layers.fc(x, size=64, act='relu')
+                y = fluid.layers.fc(h, size=64)
+                loss = fluid.layers.reduce_mean(y * y)
+                fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        main_prog.set_mesh_axes(mesh)
+        x.sharding = (None, None)   # replicated feed: bitwise comparable
+        return main_prog, startup, loss
+
+    def dev0_bytes(scope, persist):
+        total = 0
+        for n in persist:
+            arr = scope.vars.get(n)
+            if arr is None or not hasattr(arr, 'addressable_shards'):
+                continue
+            total += sum(s.data.nbytes for s in arr.addressable_shards
+                         if s.device == jax.devices()[0])
+        return total
+
+    feed = {'ps_x': np.random.RandomState(0).rand(16, 64).astype('float32')}
+    out = {}
+    for label, shard_on in (('replicated', '0'), ('sharded', '1')):
+        old = os.environ.get('PT_SHARD')
+        os.environ['PT_SHARD'] = shard_on
+        try:
+            main_prog, startup, loss = build()
+            exe, scope = fluid.Executor(mesh=mesh), fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(3):
+                    exe.run(main_prog, feed=feed, fetch_list=[loss])
+                persist = [v.name for v in main_prog.list_vars()
+                           if v.persistable]
+                out['hbm_params_bytes_%s' % label] = \
+                    dev0_bytes(scope, persist)
+            if shard_on == '1':
+                _, stats = passes.optimize_program(main_prog, (loss.name,))
+                sh = stats['passes'].get('shard') or {}
+                out['reshards_inserted'] = int(
+                    sh.get('reshards_inserted') or 0)
+                out['collective_bytes'] = int(
+                    sh.get('collective_bytes') or 0)
+        finally:
+            if old is None:
+                os.environ.pop('PT_SHARD', None)
+            else:
+                os.environ['PT_SHARD'] = old
+    rep = out.get('hbm_params_bytes_replicated') or 0
+    shd = out.get('hbm_params_bytes_sharded') or 0
+    out['hbm_sharded_ratio'] = round(shd / rep, 3) if rep else None
+    return out
+
+
 def scenario_pod_parallel(best_of):
     """Pod-story plumbing: psum bus bandwidth over the local mesh (null
-    single-device) and 2-worker lockstep throughput scaling via
-    subprocess workers — the shape the real pod gate grows into."""
+    single-device), the shard pass's replicated-vs-sharded HBM round,
+    and 2-worker lockstep throughput scaling via subprocess workers —
+    the shape the real pod gate grows into."""
     import jax
     from bench import allreduce_bw_gbps
 
     steps = _env_int('PERFLAB_POD_STEPS', 8)
+    _harness.stage('shard_round')
+    try:
+        shard_metrics = _pod_shard_round()
+    except Exception as e:  # noqa: BLE001 - diagnostic-only path
+        print('PERFLAB: shard round failed: %s' % e, file=sys.stderr)
+        shard_metrics = {}
     _harness.stage('allreduce')
     devices = jax.local_device_count()
     try:
@@ -454,6 +534,7 @@ def scenario_pod_parallel(best_of):
         'scaling_2worker_x': scaling,
         'devices': devices,
     }
+    metrics.update(shard_metrics)
     config = {'steps': steps, 'workers': 2}
     return metrics, {}, config
 
